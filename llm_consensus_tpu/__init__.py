@@ -1,0 +1,21 @@
+"""llm_consensus_tpu — a TPU-native multi-agent LLM consensus framework.
+
+A from-scratch rebuild of the capabilities of ``thepolytheist/llm-consensus``
+(reference: a Rust/actix orchestrator fanning out HTTPS calls to Gemini,
+``src/main.rs``), re-founded on local JAX/XLA/Pallas inference on TPU meshes:
+
+- the propose -> panel-evaluate -> refine consensus protocol
+  (reference ``src/main.rs:187-348``) as an asyncio state machine with
+  epoch-tagged messages (fixing the reference's round races),
+- persona/panel conditioning (reference ``src/main.rs:359-426``) driven by
+  config instead of hard-coded literals,
+- answer aggregation generalized from unanimity to self-consistency
+  majority vote / weighted vote / logit pooling,
+- a pluggable text-generation backend whose seam is exactly the reference's
+  ``call_gemini`` (``src/main.rs:82-86``): ``prompt -> text``; the production
+  backend is batched JAX inference on a TPU device mesh.
+"""
+
+from llm_consensus_tpu.version import __version__
+
+__all__ = ["__version__"]

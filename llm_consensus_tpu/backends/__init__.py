@@ -1,0 +1,10 @@
+from llm_consensus_tpu.backends.base import Backend, GenerationRequest, GenerationResult
+from llm_consensus_tpu.backends.fake import FakeBackend, ScriptedBackend
+
+__all__ = [
+    "Backend",
+    "GenerationRequest",
+    "GenerationResult",
+    "FakeBackend",
+    "ScriptedBackend",
+]

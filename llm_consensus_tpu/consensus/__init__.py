@@ -1,0 +1,22 @@
+from llm_consensus_tpu.consensus.messages import (
+    AnswerEvaluation,
+    AnswerRefinement,
+    Feedback,
+)
+from llm_consensus_tpu.consensus.coordinator import (
+    Coordinator,
+    CoordinatorConfig,
+    ConsensusResult,
+)
+from llm_consensus_tpu.consensus.personas import Persona, default_panel
+
+__all__ = [
+    "AnswerEvaluation",
+    "AnswerRefinement",
+    "Feedback",
+    "Coordinator",
+    "CoordinatorConfig",
+    "ConsensusResult",
+    "Persona",
+    "default_panel",
+]

@@ -1,0 +1,29 @@
+"""Test configuration: force JAX onto a simulated 8-device CPU mesh.
+
+The reference has no tests at all (SURVEY.md §4). Our multi-device tests
+(DP/TP/EP shardings, ring attention collectives) run on CPU-simulated
+devices via ``--xla_force_host_platform_device_count`` so they need no TPU
+(SURVEY.md §4's prescription).
+
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 simulated devices, got {len(devices)}"
+    return devices

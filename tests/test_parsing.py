@@ -1,0 +1,49 @@
+"""Unit tests for evaluation parsing (reference behavior src/main.rs:139-153)."""
+
+from llm_consensus_tpu.consensus.messages import Feedback
+from llm_consensus_tpu.consensus.parsing import parse_evaluation
+
+
+def test_good_verdict():
+    verdict, reasoning = parse_evaluation("Good\nBecause it is correct.")
+    assert verdict is Feedback.GOOD
+    assert reasoning == "Because it is correct."
+
+
+def test_needs_refinement_verdict():
+    verdict, reasoning = parse_evaluation("NeedsRefinement\nToo vague.")
+    assert verdict is Feedback.NEEDS_REFINEMENT
+    assert reasoning == "Too vague."
+
+
+def test_spaces_stripped_from_verdict_line():
+    # The reference removes ALL spaces from the first line (src/main.rs:142).
+    verdict, _ = parse_evaluation("Needs Refinement\nreason")
+    assert verdict is Feedback.NEEDS_REFINEMENT
+    verdict, _ = parse_evaluation("  Good  \nreason")
+    assert verdict is Feedback.GOOD
+
+
+def test_leading_empty_lines_skipped():
+    # Empty lines are filtered before taking the first (src/main.rs:139-141).
+    verdict, reasoning = parse_evaluation("\n\nGood\nFine.")
+    assert verdict is Feedback.GOOD
+    assert reasoning == "Fine."
+
+
+def test_unknown_verdict_counts_as_needs_refinement():
+    # Quirk #4 (SURVEY.md §5): unparseable verdicts map to NeedsRefinement.
+    verdict, _ = parse_evaluation("Excellent\nGreat answer!")
+    assert verdict is Feedback.NEEDS_REFINEMENT
+
+
+def test_empty_response_counts_as_needs_refinement():
+    verdict, reasoning = parse_evaluation("")
+    assert verdict is Feedback.NEEDS_REFINEMENT
+    assert reasoning == ""
+
+
+def test_multiline_reasoning_joined_with_blank_lines():
+    # Reference joins remaining lines with "\n\n" (src/main.rs:143).
+    verdict, reasoning = parse_evaluation("Good\nline one\nline two")
+    assert reasoning == "line one\n\nline two"

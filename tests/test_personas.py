@@ -1,0 +1,38 @@
+"""Persona registry tests (reference hard-codes its panel, src/main.rs:359-426)."""
+
+from llm_consensus_tpu.consensus.personas import (
+    Persona,
+    default_panel,
+    load_panel,
+    save_panel,
+)
+
+
+def test_default_panel_matches_reference():
+    panel = default_panel()
+    assert [p.name for p in panel] == [
+        "High Society",
+        "The Technician",
+        "Art Boy",
+        "Programming Nerd",
+    ]
+    assert [p.domain for p in panel] == [
+        "Society and Culture",
+        "Technical Detail",
+        "Art and Imagination",
+        "Computer Science",
+    ]
+    for p in panel:
+        assert p.tuning.count("*") == 10  # ten tuning bullets each
+
+
+def test_panel_json_roundtrip(tmp_path):
+    panel = default_panel() + [
+        Persona("Judge", "Law", "* statutes", model="mistral-7b", weight=2.0)
+    ]
+    path = tmp_path / "panel.json"
+    save_panel(panel, path)
+    loaded = load_panel(path)
+    assert loaded == panel
+    assert loaded[-1].weight == 2.0
+    assert loaded[-1].model == "mistral-7b"

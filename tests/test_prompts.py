@@ -1,0 +1,47 @@
+"""Prompt-builder tests (reference src/main.rs:95,111-136,166-175)."""
+
+from llm_consensus_tpu.consensus.personas import default_panel
+from llm_consensus_tpu.consensus.prompts import (
+    answer_prompt,
+    evaluation_prompt,
+    refinement_prompt,
+)
+
+
+def test_answer_prompt_shape():
+    p = answer_prompt("What is 2+2?")
+    assert p.startswith("Please answer the following question")
+    assert "without referring to yourself as a language model" in p
+    assert p.endswith("\n\nWhat is 2+2?")
+
+
+def test_evaluation_prompt_contains_rubric_and_persona():
+    persona = default_panel()[1]  # The Technician
+    p = evaluation_prompt("Q?", "A.", persona)
+    assert "Question: Q?" in p
+    assert "Answer: A." in p
+    assert persona.domain in p
+    # Tuning bullets are quote-stripped but otherwise included.
+    assert "Accuracy and precision of information" in p
+    # Few-shot examples from the reference rubric.
+    assert "What's a good beginner programming language?" in p
+    assert "Decoupling" in p
+    # Off-domain judges must abstain-approve (quirk #3).
+    assert "you should answer exactly Good" in p
+
+
+def test_prompts_strip_double_quotes():
+    # Reference strips all '"' (src/main.rs:136,175) — quirk #7.
+    persona = default_panel()[0]
+    p = evaluation_prompt('He said "hi"', 'She replied "yo"', persona)
+    assert '"' not in p
+    r = refinement_prompt('Why "x"?', 'Because "y".', persona)
+    assert '"' not in r
+
+
+def test_refinement_prompt_mentions_domain_and_tuning():
+    persona = default_panel()[3]
+    p = refinement_prompt("Q?", "A.", persona)
+    assert "you said it needed refinement" in p
+    assert persona.domain in p
+    assert "Algorithms and data structures" in p
