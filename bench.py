@@ -1,0 +1,103 @@
+"""Headline benchmark: candidate-tokens/sec/chip for self-consistency decode.
+
+Measures the BASELINE.json metric on the bench flagship (``llama-1b``,
+the single-chip preset): N-way candidate fan-out (the self-consistency
+batch axis) decoding greedily from a prefilled prompt, steady-state,
+excluding compile. Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline"}`` where ``vs_baseline`` is
+value / 1000 — BASELINE.json's north-star floor of >=1k
+candidate-tokens/sec/chip (the reference itself publishes no numbers,
+SURVEY.md §6).
+
+Runs on whatever ``jax.devices()`` provides (the real TPU chip under the
+driver; CPU elsewhere — pass --cpu to force).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama-1b")
+    p.add_argument("--n-candidates", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=128)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--cpu", action="store_true", help="force CPU backend")
+    p.add_argument("--tiny", action="store_true", help="use test-tiny model")
+    args = p.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    if args.tiny:
+        args.model = "test-tiny"
+
+    from llm_consensus_tpu.engine.generate import generate
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config(args.model)
+    dev = jax.devices()[0]
+    print(f"[bench] model={cfg.name} device={dev.platform}", file=sys.stderr)
+
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    b, s = args.n_candidates, args.prompt_len
+    tokens = jnp.ones((b, s), jnp.int32)
+    lengths = jnp.full((b,), s, jnp.int32)
+    temps = jnp.full((b,), 0.7, jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    def run(seed_key):
+        out = generate(
+            cfg,
+            params,
+            tokens,
+            lengths,
+            seed_key,
+            temps,
+            max_new_tokens=args.new_tokens,
+            eos_id=-1,  # never stop early: fixed work per run
+        )
+        return out.tokens
+
+    # Warmup/compile.
+    t0 = time.perf_counter()
+    run(key).block_until_ready()
+    compile_s = time.perf_counter() - t0
+    print(f"[bench] compile+first run: {compile_s:.1f}s", file=sys.stderr)
+
+    # Timed steady-state.
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        run(jax.random.fold_in(key, i + 1)).block_until_ready()
+    wall = (time.perf_counter() - t0) / args.iters
+
+    candidate_tokens = b * args.new_tokens
+    tps = candidate_tokens / wall
+    n_chips = jax.device_count()
+    tps_per_chip = tps / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": f"candidate-tokens/sec/chip ({cfg.name}, N={b}, "
+                f"decode {args.new_tokens} @ prompt {s})",
+                "value": round(tps_per_chip, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(tps_per_chip / 1000.0, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

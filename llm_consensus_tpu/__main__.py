@@ -1,0 +1,5 @@
+"""``python -m llm_consensus_tpu`` — the REPL/CLI entry point."""
+
+from llm_consensus_tpu.cli import main
+
+raise SystemExit(main())

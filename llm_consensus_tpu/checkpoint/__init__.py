@@ -1,0 +1,21 @@
+"""Checkpoint / resume.
+
+NOT PRESENT in the reference — all its state is in-memory and reset per
+question (``src/main.rs:198-203``; SURVEY.md §5). Here: orbax-backed
+save/restore for model params and full train states, plus JSON
+round-state snapshots so an interrupted consensus run can resume.
+"""
+
+from llm_consensus_tpu.checkpoint.io import (
+    load_params,
+    restore_train_state,
+    save_params,
+    save_train_state,
+)
+
+__all__ = [
+    "load_params",
+    "restore_train_state",
+    "save_params",
+    "save_train_state",
+]

@@ -1,0 +1,80 @@
+"""Orbax-backed checkpoint IO for params and train states.
+
+Checkpoint/resume is a build requirement the reference lacks entirely
+(SURVEY.md §5 — every crash loses all state). Uses orbax's
+StandardCheckpointer: async-friendly, works with sharded arrays (each
+host writes its shards; restore honors a target sharding), so the same
+API covers single-chip and multi-slice meshes.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _ckptr() -> ocp.StandardCheckpointer:
+    return ocp.StandardCheckpointer()
+
+
+def save_params(path: str | Path, params: dict) -> None:
+    """Save a param pytree to ``path`` (a directory)."""
+    path = Path(path).absolute()
+    ckptr = _ckptr()
+    ckptr.save(path / "params", params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_params(path: str | Path, target: dict | None = None) -> dict:
+    """Restore params. ``target`` (abstract pytree of jax.ShapeDtypeStruct
+    or concrete arrays) pins dtypes/shardings; None restores as saved."""
+    path = Path(path).absolute()
+    ckptr = _ckptr()
+    if target is not None:
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+            if hasattr(x, "shape")
+            else x,
+            target,
+        )
+        return ckptr.restore(path / "params", abstract)
+    return ckptr.restore(path / "params")
+
+
+def save_train_state(path: str | Path, state, extra: dict | None = None) -> None:
+    """Save a full TrainState (params + opt state + step) and optional
+    JSON metadata (e.g. dataset position, rng seed) for exact resume."""
+    path = Path(path).absolute()
+    ckptr = _ckptr()
+    ckptr.save(path / "state", state, force=True)
+    ckptr.wait_until_finished()
+    if extra is not None:
+        (path / "meta.json").write_text(json.dumps(extra))
+
+
+def restore_train_state(path: str | Path, target):
+    """Restore a TrainState saved by :func:`save_train_state`.
+
+    ``target``: a template TrainState (same treedef; arrays may be
+    abstract) — required because opt states are arbitrary pytrees.
+    Returns (state, extra_metadata_dict_or_None).
+    """
+    path = Path(path).absolute()
+    ckptr = _ckptr()
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        )
+        if hasattr(x, "shape")
+        else x,
+        target,
+    )
+    state = ckptr.restore(path / "state", abstract)
+    meta_file = path / "meta.json"
+    extra = json.loads(meta_file.read_text()) if meta_file.exists() else None
+    return state, extra

@@ -1,0 +1,193 @@
+"""CLI / REPL driver — parity with the reference's L4 layer.
+
+The reference REPL (``src/main.rs:428-471``): prompt ``"Enter a question: "``,
+read a line, ``exit`` terminates, ask the Coordinator, poll readiness
+every 500 ms (a hot spin, ``src/main.rs:448-459``), print the final
+answer, reset. Differences here, per SURVEY.md §7 step 5:
+
+- the readiness poll is a real ``await`` on the protocol task — no spin;
+- panel/backends/round-cap come from flags and JSON config instead of
+  hard-coded literals (reference ``src/main.rs:359-426`` + TODO at
+  ``:299``);
+- a missing API key cannot happen: the default substrate is local. A
+  ``fake`` backend stands in where the reference required
+  ``GEMINI_API_KEY`` or died (``src/main.rs:354-357``);
+- ``--eval-gsm8k`` runs the batch GSM8K harness instead of the REPL.
+
+Run: ``python -m llm_consensus_tpu [--backend fake|local] ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+from llm_consensus_tpu.backends.base import SamplingParams
+from llm_consensus_tpu.backends.fake import FakeBackend
+from llm_consensus_tpu.consensus.coordinator import Coordinator, CoordinatorConfig
+from llm_consensus_tpu.consensus.personas import default_panel, load_panel
+
+log = logging.getLogger("llm_consensus_tpu")
+
+
+def _init_logging() -> None:
+    """env_logger parity (reference ``src/main.rs:352``): level comes from
+    the ``LLM_CONSENSUS_LOG`` env var (RUST_LOG convention, default info)."""
+    level = os.environ.get("LLM_CONSENSUS_LOG", "info").upper()
+    logging.basicConfig(
+        level=getattr(logging, level, logging.INFO),
+        format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
+    )
+
+
+def _build_backend(args):
+    if args.backend == "fake":
+        return FakeBackend()
+    # Local on-device inference. Import lazily: jax/device init is heavy
+    # and the fake path must stay instant.
+    import jax
+
+    from llm_consensus_tpu.backends.local import LocalBackend
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.engine.tokenizer import load_tokenizer
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config(args.model)
+    if args.checkpoint:
+        from llm_consensus_tpu.checkpoint.io import load_params
+
+        params = load_params(args.checkpoint)
+    else:
+        log.warning(
+            "No --checkpoint given: using RANDOM weights for %s "
+            "(protocol/e2e plumbing only; text will be gibberish).",
+            cfg.name,
+        )
+        params = init_params(cfg, jax.random.PRNGKey(0))
+    engine = InferenceEngine(
+        cfg,
+        params,
+        tokenizer=load_tokenizer(args.tokenizer),
+        engine_config=EngineConfig(max_new_tokens=args.max_new_tokens),
+    )
+    return LocalBackend(engine)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="llm_consensus_tpu",
+        description="Multi-persona LLM consensus on local TPU inference.",
+    )
+    p.add_argument("--backend", choices=["fake", "local"], default="fake")
+    p.add_argument("--model", default="llama-1b", help="model preset name")
+    p.add_argument("--checkpoint", default=None, help="orbax checkpoint dir")
+    p.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
+    p.add_argument("--panel", default=None, help="panel JSON file")
+    p.add_argument(
+        "--max-rounds",
+        type=int,
+        default=5,
+        help="evaluation-round cap (the reference hard-codes 5, "
+        "src/main.rs:299-300)",
+    )
+    p.add_argument("--max-new-tokens", type=int, default=256)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument(
+        "--question", default=None, help="answer one question and exit"
+    )
+    p.add_argument(
+        "--eval-gsm8k",
+        default=None,
+        metavar="JSONL|synthetic",
+        help="run the GSM8K EM harness on a JSONL file or 'synthetic'",
+    )
+    p.add_argument("--eval-n", type=int, default=8, help="candidates per problem")
+    p.add_argument("--eval-limit", type=int, default=20)
+    return p
+
+
+async def repl(coord: Coordinator, stream=None) -> None:
+    """Interactive loop with reference UX parity (``src/main.rs:428-471``)."""
+    out = stream or sys.stdout
+    while True:
+        out.write("Enter a question: ")
+        out.flush()
+        line = await asyncio.to_thread(sys.stdin.readline)
+        if not line:
+            break
+        question = line.strip()
+        if question == "exit":
+            break
+        if not question:
+            continue
+        await coord.ask_question(question)
+        answer = await coord.wait_for_answer()
+        log.info("Final answer: %s", answer)
+        out.write(f"\n{answer}\n\n")
+        coord.reset()
+
+
+def main(argv: list[str] | None = None) -> int:
+    _init_logging()
+    args = build_parser().parse_args(argv)
+
+    if args.eval_gsm8k is not None:
+        return _run_eval(args)
+
+    panel = load_panel(args.panel) if args.panel else default_panel()
+    backend = _build_backend(args)
+    coord = Coordinator(
+        panel,
+        backend,
+        CoordinatorConfig(
+            max_rounds=args.max_rounds,
+            seed=args.seed,
+            sampling=SamplingParams(
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+            ),
+        ),
+    )
+    if args.question is not None:
+        result = asyncio.run(coord.run(args.question))
+        print(result.answer)
+        return 0
+    asyncio.run(repl(coord))
+    return 0
+
+
+def _run_eval(args) -> int:
+    import json
+
+    from llm_consensus_tpu.eval.gsm8k import (
+        evaluate_self_consistency,
+        load_gsm8k,
+        synthetic_problems,
+    )
+
+    if args.backend == "fake":
+        print("GSM8K eval needs --backend local", file=sys.stderr)
+        return 2
+    backend = _build_backend(args)
+    if args.eval_gsm8k == "synthetic":
+        problems = synthetic_problems(args.eval_limit)
+    else:
+        problems = load_gsm8k(args.eval_gsm8k, limit=args.eval_limit)
+    report = evaluate_self_consistency(
+        backend.engine,
+        problems,
+        n=args.eval_n,
+        temperature=args.temperature,
+        max_new_tokens=args.max_new_tokens,
+    )
+    print(json.dumps(report.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,217 @@
+"""Answer aggregation: majority vote, weighted vote, logit pooling.
+
+The reference's only aggregation rule is *unanimity* — every panelist's
+feedback must be ``Good`` (``src/main.rs:316-325``), with forced approval
+at the round cap (``:308-311``). Per SURVEY.md §7(c) and BASELINE.json,
+the rebuild generalizes this to N-way self-consistency:
+
+- :func:`majority_vote` / :func:`weighted_vote` — host-side aggregation
+  over canonicalized answers (heterogeneous panels use persona weights).
+- :func:`logit_pool` — pool candidates by total probability mass
+  (sum of per-candidate sequence probabilities per distinct answer).
+- :func:`device_majority_vote` — the on-device reducer from the north
+  star: candidates live on the ``data`` mesh axis; the tally is a one-hot
+  ``psum`` over that axis + argmax, so the vote rides ICI instead of a
+  host gather.
+- :func:`self_consistency` — end-to-end: one batched N-way sample on an
+  :class:`InferenceEngine`, canonicalize, vote.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+# ---------------------------------------------------------------------------
+# Canonicalization
+# ---------------------------------------------------------------------------
+
+_NUM_RE = re.compile(r"-?\$?\d[\d,]*(?:\.\d+)?")
+
+
+def extract_final_number(text: str) -> str | None:
+    """Extract a final numeric answer (GSM8K-style EM key).
+
+    Honors an explicit ``#### <answer>`` marker when present, else takes
+    the last number in the text. Commas/dollar signs are stripped;
+    ``42.0`` canonicalizes to ``42``.
+    """
+    marker = text.rsplit("####", 1)
+    hay = marker[1] if len(marker) == 2 else text
+    matches = _NUM_RE.findall(hay)
+    if not matches:
+        return None
+    raw = matches[-1].replace(",", "").replace("$", "")
+    try:
+        val = float(raw)
+    except ValueError:
+        return None
+    return str(int(val)) if val == int(val) else str(val)
+
+
+def canonicalize(text: str) -> str:
+    """Default answer key: final number when present, else normalized text."""
+    num = extract_final_number(text)
+    if num is not None:
+        return num
+    return " ".join(text.strip().lower().split())
+
+
+# ---------------------------------------------------------------------------
+# Host-side voting
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class VoteResult:
+    winner: str  # canonical key of the winning answer
+    text: str  # a representative raw answer carrying the winning key
+    tally: dict[str, float]
+    n_candidates: int
+
+
+def _vote(
+    answers: list[str],
+    scores: list[float],
+    key_fn,
+) -> VoteResult:
+    if not answers:
+        raise ValueError("no answers to vote over")
+    tally: dict[str, float] = defaultdict(float)
+    rep: dict[str, str] = {}
+    for ans, sc in zip(answers, scores):
+        k = key_fn(ans)
+        tally[k] += sc
+        rep.setdefault(k, ans)
+    winner = max(tally.items(), key=lambda kv: kv[1])[0]
+    return VoteResult(
+        winner=winner,
+        text=rep[winner],
+        tally=dict(tally),
+        n_candidates=len(answers),
+    )
+
+
+def majority_vote(answers: list[str], key_fn=canonicalize) -> VoteResult:
+    """Uniform one-candidate-one-vote (self-consistency, Wang et al.)."""
+    return _vote(answers, [1.0] * len(answers), key_fn)
+
+
+def weighted_vote(
+    answers: list[str], weights: list[float], key_fn=canonicalize
+) -> VoteResult:
+    """Per-candidate weights — heterogeneous panels vote with persona
+    weights (BASELINE.md config[3])."""
+    if len(weights) != len(answers):
+        raise ValueError("weights and answers must align")
+    return _vote(answers, list(weights), key_fn)
+
+
+def logit_pool(
+    answers: list[str], logprobs: list[float], key_fn=canonicalize
+) -> VoteResult:
+    """Pool by probability mass: each candidate contributes
+    ``exp(logprob)`` (normalized over the batch for stability)."""
+    if len(logprobs) != len(answers):
+        raise ValueError("logprobs and answers must align")
+    lp = np.asarray(logprobs, np.float64)
+    w = np.exp(lp - lp.max())  # softmax-style stabilization
+    return _vote(answers, list(w / w.sum()), key_fn)
+
+
+# ---------------------------------------------------------------------------
+# On-device reducer (north-star: all-gather/psum + argmax over candidates)
+# ---------------------------------------------------------------------------
+
+
+def device_majority_vote(
+    candidate_ids: jnp.ndarray,
+    n_classes: int,
+    mesh: Mesh,
+    weights: jnp.ndarray | None = None,
+    axis_name: str = "data",
+) -> tuple[int, np.ndarray]:
+    """Tally candidate class-ids across the ``data`` mesh axis on device.
+
+    candidate_ids: [N] int32, sharded over ``axis_name`` (the candidate
+    fan-out axis). The tally is a one-hot reduction ``psum``-ed over the
+    axis; argmax of the pooled histogram picks the winner. Ties break
+    toward the lower id (argmax convention).
+
+    Returns (winner_id, histogram) on host.
+    """
+    if weights is None:
+        weights = jnp.ones_like(candidate_ids, jnp.float32)
+
+    def tally(ids, w):
+        onehot = jax.nn.one_hot(ids, n_classes, dtype=jnp.float32)
+        local = jnp.sum(onehot * w[:, None], axis=0)
+        hist = jax.lax.psum(local, axis_name)
+        return jnp.argmax(hist).astype(jnp.int32), hist
+
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        tally,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(P(), P()),
+    )
+    winner, hist = jax.jit(fn)(candidate_ids, weights)
+    return int(winner), np.asarray(hist)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end self-consistency over an engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SelfConsistencyResult:
+    vote: VoteResult
+    candidates: list[str]
+    logprobs: list[float]
+    total_tokens: int
+
+
+def self_consistency(
+    engine,
+    prompt: str,
+    n: int,
+    temperature: float = 0.7,
+    seed: int = 0,
+    max_new_tokens: int | None = None,
+    method: str = "majority",
+    key_fn=canonicalize,
+) -> SelfConsistencyResult:
+    """N-way self-consistency: ONE batched sample of n candidates on the
+    engine (the candidate axis is the mesh ``data`` axis when sharded),
+    then vote. ``method``: majority | logit_pool.
+    """
+    results = engine.generate_texts(
+        [prompt] * n,
+        temperatures=[temperature] * n,
+        seed=seed,
+        max_new_tokens=max_new_tokens,
+    )
+    texts = [r.text for r in results]
+    lps = [r.logprob for r in results]
+    if method == "majority":
+        vote = majority_vote(texts, key_fn)
+    elif method == "logit_pool":
+        vote = logit_pool(texts, lps, key_fn)
+    else:
+        raise ValueError(f"unknown aggregation method {method!r}")
+    return SelfConsistencyResult(
+        vote=vote,
+        candidates=texts,
+        logprobs=lps,
+        total_tokens=sum(r.num_tokens for r in results),
+    )

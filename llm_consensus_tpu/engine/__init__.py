@@ -1,0 +1,29 @@
+"""Inference engine: tokenizer, sampler, batched generation loop.
+
+This package is the TPU-native replacement for the reference's entire
+"compute layer" — one remote Gemini call per protocol step
+(``src/main.rs:82-86``). Here a whole panel fan-out or N-way
+self-consistency batch is ONE compiled device program: prefill + a
+``lax.scan`` decode loop over static shapes.
+"""
+
+from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+from llm_consensus_tpu.engine.generate import GenerateOutput, generate
+from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
+from llm_consensus_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    Tokenizer,
+    load_tokenizer,
+)
+
+__all__ = [
+    "ByteTokenizer",
+    "EngineConfig",
+    "GenerateOutput",
+    "InferenceEngine",
+    "SamplerConfig",
+    "Tokenizer",
+    "generate",
+    "load_tokenizer",
+    "sample_token",
+]

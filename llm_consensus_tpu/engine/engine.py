@@ -1,0 +1,169 @@
+"""InferenceEngine: text-in/text-out over the compiled generate loop.
+
+The host-side runtime around :func:`llm_consensus_tpu.engine.generate`:
+tokenization, right-padding, shape bucketing (so repeat calls hit the jit
+cache instead of recompiling), PRNG key management, and detokenization.
+This object is what :class:`llm_consensus_tpu.backends.local.LocalBackend`
+exposes through the ``Backend`` seam — i.e. it stands exactly where the
+reference's ``call_gemini`` stood (``src/main.rs:82-86``), but batched.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_consensus_tpu.engine.generate import GenerateOutput, generate
+from llm_consensus_tpu.engine.sampler import SamplerConfig
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
+from llm_consensus_tpu.models.configs import ModelConfig
+
+log = logging.getLogger(__name__)
+
+
+def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class EngineConfig:
+    max_new_tokens: int = 256
+    # Prompt-length buckets (right-padded up; keeps the jit cache small).
+    seq_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
+    # Batch-size buckets (padded up with dummy rows).
+    batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    sampler: SamplerConfig = field(default_factory=SamplerConfig)
+
+
+@dataclass
+class EngineResult:
+    text: str
+    num_tokens: int
+    logprob: float
+    token_ids: list[int]
+
+
+class InferenceEngine:
+    """Batched local text generation on one model's weights."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        tokenizer: Tokenizer | None = None,
+        engine_config: EngineConfig | None = None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer or ByteTokenizer()
+        if self.tokenizer.vocab_size > cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {self.tokenizer.vocab_size} exceeds model "
+                f"vocab {cfg.vocab_size}"
+            )
+        self.config = engine_config or EngineConfig()
+
+    # ------------------------------------------------------------------
+
+    def _prepare(
+        self, prompts: list[str]
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        tok = self.tokenizer
+        encoded = [tok.encode(p) for p in prompts]
+        # Left-truncate over-long prompts (keep the question tail); the cap
+        # is the model context, not just the largest bucket.
+        max_prompt = min(self.config.seq_buckets[-1], self.cfg.max_seq_len - 1)
+        encoded = [ids[-max_prompt:] for ids in encoded]
+        longest = max(len(ids) for ids in encoded)
+        s = _next_bucket(longest, self.config.seq_buckets)
+        s = min(s, self.cfg.max_seq_len)
+        b = _next_bucket(len(encoded), self.config.batch_buckets)
+        tokens = np.full((b, s), tok.pad_id, np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i, ids in enumerate(encoded):
+            tokens[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+        # Dummy pad rows get length 1 so gather/clip stay in range.
+        lengths[len(encoded) :] = 1
+        return tokens, lengths, len(encoded)
+
+    def generate_texts(
+        self,
+        prompts: list[str],
+        temperatures: list[float] | None = None,
+        seed: int = 0,
+        max_new_tokens: int | None = None,
+        sampler: SamplerConfig | None = None,
+    ) -> list[EngineResult]:
+        """Generate one completion per prompt.
+
+        One device program per chunk of ``batch_buckets[-1]`` prompts;
+        most calls fit a single chunk. ``sampler`` overrides the engine's
+        default top-k/top-p config for this call.
+        """
+        if not prompts:
+            return []
+        chunk = self.config.batch_buckets[-1]
+        if len(prompts) > chunk:
+            out: list[EngineResult] = []
+            for i in range(0, len(prompts), chunk):
+                temps_i = (
+                    temperatures[i : i + chunk]
+                    if temperatures is not None
+                    else None
+                )
+                out.extend(
+                    self.generate_texts(
+                        prompts[i : i + chunk],
+                        temperatures=temps_i,
+                        seed=seed + i,
+                        max_new_tokens=max_new_tokens,
+                        sampler=sampler,
+                    )
+                )
+            return out
+        tokens, lengths, n_real = self._prepare(prompts)
+        b = tokens.shape[0]
+        temps = np.zeros((b,), np.float32)
+        if temperatures is not None:
+            temps[:n_real] = np.asarray(temperatures, np.float32)
+        mnt = max_new_tokens or self.config.max_new_tokens
+        # Clamp so prompt + generation fits the model context.
+        mnt = max(1, min(mnt, self.cfg.max_seq_len - tokens.shape[1]))
+
+        out: GenerateOutput = generate(
+            self.cfg,
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jax.random.PRNGKey(seed),
+            jnp.asarray(temps),
+            max_new_tokens=mnt,
+            sampler=sampler if sampler is not None else self.config.sampler,
+            eos_id=self.tokenizer.eos_id,
+            pad_id=self.tokenizer.pad_id,
+        )
+        toks = np.asarray(out.tokens)
+        nums = np.asarray(out.num_tokens)
+        lps = np.asarray(out.logprob_sum)
+
+        results = []
+        for i in range(n_real):
+            n = int(nums[i])
+            ids = [int(t) for t in toks[i, :n] if t != self.tokenizer.eos_id]
+            results.append(
+                EngineResult(
+                    text=self.tokenizer.decode(ids),
+                    num_tokens=n,
+                    logprob=float(lps[i]),
+                    token_ids=ids,
+                )
+            )
+        return results

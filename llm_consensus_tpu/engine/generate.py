@@ -1,0 +1,117 @@
+"""Batched generation: prefill + a ``lax.scan`` decode loop.
+
+This is the hot loop of the whole framework — the TPU-native equivalent of
+the reference's per-step remote call (``src/main.rs:82-86``), restructured
+so a whole panel evaluation round or an N-way self-consistency fan-out is
+ONE device program:
+
+- prompts are right-padded into a [B, S] batch (B = panel size x
+  candidates = the data-parallel axis of the mesh);
+- ``prefill`` fills the KV cache and yields last-token logits;
+- the decode loop is ``lax.scan`` over ``max_new_tokens`` static steps —
+  no data-dependent Python control flow; early termination is a ``done``
+  mask (rows that hit EOS keep stepping but emit pad and stop
+  accumulating logprobs). XLA compiles one step body once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
+from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.configs import ModelConfig
+from llm_consensus_tpu.models.transformer import decode_step, prefill
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class GenerateOutput:
+    tokens: jnp.ndarray  # [B, max_new_tokens] int32, pad-filled after EOS
+    num_tokens: jnp.ndarray  # [B] int32 generated tokens incl. EOS
+    logprob_sum: jnp.ndarray  # [B] float32 sum of sampled-token logprobs
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "max_new_tokens",
+        "sampler",
+        "eos_id",
+        "pad_id",
+        "cache_len",
+    ),
+)
+def generate(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    sampler: SamplerConfig = SamplerConfig(),
+    eos_id: int = 2,
+    pad_id: int = 0,
+    cache_len: int | None = None,
+) -> GenerateOutput:
+    """Generate up to ``max_new_tokens`` for a batch of right-padded prompts.
+
+    tokens: [B, S] int32 right-padded prompts; lengths: [B] true lengths;
+    key: PRNG key (folded per decode step; rows draw independent samples
+    from the batched categorical); temperature: [B] per-row (0 = greedy).
+    """
+    b, s = tokens.shape
+    if cache_len is None:
+        cache_len = s + max_new_tokens
+    if cache_len < s + max_new_tokens:
+        raise ValueError(
+            f"cache_len {cache_len} < prompt {s} + max_new_tokens {max_new_tokens}"
+        )
+
+    cache = KVCache.create(cfg, b, cache_len)
+    logits, cache = prefill(cfg, params, tokens, lengths, cache)
+
+    key0 = jax.random.fold_in(key, 0)
+    tok0, lp0 = sample_token(logits, key0, temperature, sampler)
+    done0 = tok0 == eos_id
+    # Logprob of a sampled token counts even if that token is EOS.
+    carry0 = (tok0, cache, done0, lp0)
+
+    def step(carry, i):
+        tok, cache, done, lp_sum = carry
+        logits, cache = decode_step(cfg, params, tok[:, None], cache)
+        step_key = jax.random.fold_in(key, i + 1)
+        next_tok, lp = sample_token(logits, step_key, temperature, sampler)
+        next_tok = jnp.where(done, pad_id, next_tok)
+        lp_sum = lp_sum + jnp.where(done, 0.0, lp)
+        next_done = done | (next_tok == eos_id)
+        # Emitted token for this scan slot is the PREVIOUS carry token:
+        # slot i holds the (i+1)-th generated token.
+        return (next_tok, cache, next_done, lp_sum), (next_tok, done)
+
+    if max_new_tokens > 1:
+        (tok_last, _, _, lp_sum), (toks, dones) = jax.lax.scan(
+            step, carry0, jnp.arange(max_new_tokens - 1)
+        )
+        # [B, T]: first sampled token then the scanned ones.
+        all_toks = jnp.concatenate([tok0[:, None], toks.T], axis=1)
+        all_done_before = jnp.concatenate(
+            [jnp.zeros((b, 1), bool), dones.T], axis=1
+        )
+    else:
+        lp_sum = lp0
+        all_toks = tok0[:, None]
+        all_done_before = jnp.zeros((b, 1), bool)
+
+    num = jnp.sum(~all_done_before, axis=1).astype(jnp.int32)
+    all_toks = jnp.where(all_done_before, pad_id, all_toks)
+    return GenerateOutput(
+        tokens=all_toks, num_tokens=num, logprob_sum=lp_sum
+    )

@@ -1,0 +1,92 @@
+"""On-device token sampling: greedy, temperature, top-k, top-p.
+
+Reference counterpart: none — sampling happens inside the remote Gemini
+service (``src/main.rs:82-86``). For self-consistency fan-out
+(BASELINE.json configs, N up to 64) the sampler runs *on device inside the
+compiled decode loop*: per-candidate PRNG keys live on the batch axis, so
+one ``lax.scan`` step samples all N candidates.
+
+XLA-first constraints honored here:
+- ``top_k``/``top_p`` are **static** (part of the compiled program);
+  per-example *temperature* is dynamic data ([B] array). temperature == 0
+  selects greedy via ``jnp.where`` — no control flow on data.
+- Everything is shape-static: top-p uses a sorted-scan mask, not dynamic
+  slicing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class SamplerConfig:
+    """Static (compile-time) sampler configuration."""
+
+    top_k: int = 0  # 0 => disabled
+    top_p: float = 1.0  # 1.0 => disabled
+
+
+def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask all but the k highest logits. k is static."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    vals, _ = jax.lax.top_k(logits, k)
+    kth = vals[..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the sorted
+    distribution with cumulative probability >= p. p is static."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # Keep entries whose *preceding* cumulative mass is < p (so the first
+    # token crossing the threshold is still kept).
+    keep_sorted = (cum - sorted_probs) < p
+    # Find the minimum kept logit; anything below it is masked.
+    min_kept = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < min_kept, _NEG_INF, logits)
+
+
+def sample_token(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    config: SamplerConfig = SamplerConfig(),
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample one token per row.
+
+    logits: [B, V] float32; key: single PRNG key (folded per step by the
+    caller); temperature: [B] (0 => greedy for that row).
+
+    Returns (tokens [B] int32, logprobs [B] float32) where logprobs are the
+    log-probability of the sampled token under the *pre-filtering*
+    temperature-scaled distribution (usable for logit-pooled vote
+    aggregation, BASELINE.json north star).
+    """
+    b = logits.shape[0]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Temperature-scale with a safe divisor for greedy rows.
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+    filtered = _apply_top_p(_apply_top_k(scaled, config.top_k), config.top_p)
+    sampled_tok = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+
+    tok = jnp.where(temperature > 0, sampled_tok, greedy_tok)
+
+    logprobs_full = jax.nn.log_softmax(scaled, axis=-1)
+    logprob = logprobs_full[jnp.arange(b), tok]
+    return tok, logprob

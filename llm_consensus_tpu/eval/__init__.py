@@ -1,0 +1,25 @@
+"""Batch evaluation: GSM8K exact-match + throughput measurement.
+
+SURVEY.md §7(f): the reference is manually tested via its REPL and
+publishes no benchmarks (§6); the rebuild's accuracy/throughput targets
+come from BASELINE.json (GSM8K EM at N∈{1,8,32,64} self-consistency,
+candidate-tokens/sec/chip).
+"""
+
+from llm_consensus_tpu.eval.gsm8k import (
+    EvalReport,
+    Problem,
+    evaluate_self_consistency,
+    exact_match,
+    load_gsm8k,
+    synthetic_problems,
+)
+
+__all__ = [
+    "EvalReport",
+    "Problem",
+    "evaluate_self_consistency",
+    "exact_match",
+    "load_gsm8k",
+    "synthetic_problems",
+]

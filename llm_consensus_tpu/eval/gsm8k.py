@@ -1,0 +1,170 @@
+"""GSM8K-style exact-match evaluation with self-consistency voting.
+
+The accuracy metric of BASELINE.json: GSM8K EM at N-way self-consistency
+majority vote. The reference has no evaluation at all (SURVEY.md §4/§6).
+
+Data comes from a local JSONL file when available (fields ``question`` /
+``answer``, GSM8K convention: gold answer after ``####``); this
+environment is zero-egress, so :func:`synthetic_problems` provides a
+deterministic arithmetic dataset with the same shape for offline tests
+and plumbing benchmarks.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from llm_consensus_tpu.consensus.voting import (
+    extract_final_number,
+    logit_pool,
+    majority_vote,
+)
+
+
+@dataclass(frozen=True)
+class Problem:
+    question: str
+    answer: str  # canonical gold answer (a number string for GSM8K)
+
+
+def exact_match(predicted: str | None, gold: str) -> bool:
+    """EM on canonical final numbers (commas/$ stripped, 42.0 == 42)."""
+    if predicted is None:
+        return False
+    gold_c = extract_final_number(gold)
+    return predicted == (gold_c if gold_c is not None else gold.strip())
+
+
+def load_gsm8k(path: str | Path, limit: int | None = None) -> list[Problem]:
+    """Load GSM8K JSONL: {"question": ..., "answer": "...#### N"}."""
+    problems = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            problems.append(Problem(question=d["question"], answer=d["answer"]))
+            if limit and len(problems) >= limit:
+                break
+    return problems
+
+
+def synthetic_problems(n: int, seed: int = 0) -> list[Problem]:
+    """Deterministic GSM8K-shaped arithmetic problems (offline stand-in)."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        a, b, c = rng.randint(2, 60), rng.randint(2, 60), rng.randint(2, 9)
+        q = (
+            f"A basket holds {a} apples. {b} more are added, then the "
+            f"total is multiplied by {c} for a festival order. "
+            f"How many apples are in the order?"
+        )
+        ans = (a + b) * c
+        out.append(Problem(question=q, answer=f"#### {ans}"))
+    return out
+
+
+@dataclass
+class EvalReport:
+    n_problems: int
+    n_candidates: int
+    em: float
+    total_candidate_tokens: int
+    wall_seconds: float
+    method: str
+    per_problem: list[dict] = field(default_factory=list)
+
+    @property
+    def candidate_tokens_per_sec(self) -> float:
+        return self.total_candidate_tokens / max(self.wall_seconds, 1e-9)
+
+    def to_dict(self) -> dict:
+        return {
+            "n_problems": self.n_problems,
+            "n_candidates": self.n_candidates,
+            "em": self.em,
+            "total_candidate_tokens": self.total_candidate_tokens,
+            "wall_seconds": self.wall_seconds,
+            "candidate_tokens_per_sec": self.candidate_tokens_per_sec,
+            "method": self.method,
+        }
+
+
+_PROMPT = (
+    "Solve the math problem. Show your reasoning, then give the final "
+    "numeric answer after '####'.\n\nQuestion: {q}\nAnswer:"
+)
+
+
+def evaluate_self_consistency(
+    engine,
+    problems: list[Problem],
+    n: int = 8,
+    temperature: float = 0.7,
+    seed: int = 0,
+    max_new_tokens: int | None = None,
+    method: str = "majority",
+    prompt_template: str = _PROMPT,
+) -> EvalReport:
+    """EM with N-way self-consistency.
+
+    All N candidates of one problem run as ONE batched device program on
+    the engine (the candidate axis = the mesh ``data`` axis). N=1 with
+    temperature 0 degenerates to the greedy correctness baseline
+    (BASELINE.md config[0]).
+    """
+    correct = 0
+    total_tokens = 0
+    per_problem = []
+    t0 = time.perf_counter()
+    for i, prob in enumerate(problems):
+        prompt = prompt_template.format(q=prob.question)
+        temps = [temperature if n > 1 else 0.0] * n
+        results = engine.generate_texts(
+            [prompt] * n,
+            temperatures=temps,
+            seed=seed + i,
+            max_new_tokens=max_new_tokens,
+        )
+        texts = [r.text for r in results]
+        total_tokens += sum(r.num_tokens for r in results)
+        if method == "majority":
+            vote = majority_vote(texts, key_fn=_answer_key)
+        elif method == "logit_pool":
+            vote = logit_pool(
+                texts, [r.logprob for r in results], key_fn=_answer_key
+            )
+        else:
+            raise ValueError(f"unknown method {method!r}")
+        pred = vote.winner if vote.winner != _NO_ANSWER else None
+        ok = exact_match(pred, prob.answer)
+        correct += ok
+        per_problem.append(
+            {"question": prob.question, "pred": pred, "gold": prob.answer, "em": ok}
+        )
+    wall = time.perf_counter() - t0
+    return EvalReport(
+        n_problems=len(problems),
+        n_candidates=n,
+        em=correct / max(len(problems), 1),
+        total_candidate_tokens=total_tokens,
+        wall_seconds=wall,
+        method=method,
+        per_problem=per_problem,
+    )
+
+
+_NO_ANSWER = "<no-answer>"
+
+
+def _answer_key(text: str) -> str:
+    """Vote key: the extracted final number; answerless candidates pool
+    under a sentinel so they can't outvote real answers by accident
+    unless they truly dominate."""
+    num = extract_final_number(text)
+    return num if num is not None else _NO_ANSWER

@@ -1,0 +1,21 @@
+from llm_consensus_tpu.models.configs import ModelConfig, get_config, PRESETS
+from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.transformer import (
+    init_params,
+    forward,
+    prefill,
+    decode_step,
+    param_count,
+)
+
+__all__ = [
+    "ModelConfig",
+    "get_config",
+    "PRESETS",
+    "KVCache",
+    "init_params",
+    "forward",
+    "prefill",
+    "decode_step",
+    "param_count",
+]

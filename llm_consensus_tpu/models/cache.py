@@ -1,0 +1,49 @@
+"""KV cache: a fixed-shape pytree so decode steps compile once.
+
+Per BASELINE.json's north star the cache shards per-candidate in HBM: the
+batch axis (= candidate axis for self-consistency fan-out) carries the
+``data`` mesh axis, kv heads carry ``model`` (see
+``llm_consensus_tpu.parallel.partitioning``). Static max_len keeps XLA
+shapes fixed; per-sequence fill lengths are data, not shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.models.configs import ModelConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVCache:
+    # [n_layers, B, max_len, n_kv_heads, head_dim]
+    k: jnp.ndarray
+    v: jnp.ndarray
+    # [B] number of filled slots per sequence.
+    length: jnp.ndarray
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+    ) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        return KVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((batch,), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    def advanced(self, n: int | jnp.ndarray = 1) -> "KVCache":
+        """Return a cache with fill length advanced by n."""
+        return KVCache(k=self.k, v=self.v, length=self.length + n)
+
+    def with_length(self, length: jnp.ndarray) -> "KVCache":
+        return KVCache(k=self.k, v=self.v, length=length)

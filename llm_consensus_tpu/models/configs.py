@@ -1,0 +1,145 @@
+"""Model configurations for the persona-panel model families.
+
+The reference has no model code (its model is the remote Gemini API,
+``src/main.rs:82-86``). The families here are the ones BASELINE.md's target
+configs name: Llama-3-8B (north star), Mistral-7B and Qwen2-7B
+(heterogeneous panel, config[3]), Mixtral-8x7B MoE (config[2]), plus small
+test/bench presets. All are one architecture family — pre-norm transformer,
+GQA attention, RoPE, SwiGLU — differing in dims and two flags (qkv bias for
+Qwen2, MoE for Mixtral), so one functional implementation serves all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    qkv_bias: bool = False  # Qwen2 uses bias on q/k/v projections
+    tie_embeddings: bool = False
+    # MoE (Mixtral): 0 experts = dense MLP.
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    # Use the fused Pallas kernels (ops/pallas) for attention + RMSNorm on
+    # the hot path; False = pure-XLA jnp reference ops.
+    use_pallas: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+
+PRESETS: dict[str, ModelConfig] = {
+    # North-star flagship (BASELINE.json).
+    "llama3-8b": ModelConfig(
+        name="llama3-8b",
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=500000.0,
+        max_seq_len=8192,
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b",
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=10000.0,
+        max_seq_len=8192,
+    ),
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b",
+        vocab_size=152064,
+        d_model=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        d_ff=18944,
+        rope_theta=1000000.0,
+        qkv_bias=True,
+        max_seq_len=8192,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=1000000.0,
+        n_experts=8,
+        n_experts_per_token=2,
+        max_seq_len=8192,
+    ),
+    # ~1.1B dense config for single-chip benchmarking (fits v5e HBM in bf16
+    # with a large candidate batch).
+    "llama-1b": ModelConfig(
+        name="llama-1b",
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=5632,
+        rope_theta=10000.0,
+        max_seq_len=4096,
+    ),
+    # Tiny configs for tests (CPU-simulated meshes). vocab 384 >= the
+    # ByteTokenizer's 259 ids so end-to-end text tests can run on them.
+    "test-tiny": ModelConfig(
+        name="test-tiny",
+        vocab_size=384,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=128,
+    ),
+    "test-tiny-moe": ModelConfig(
+        name="test-tiny-moe",
+        vocab_size=384,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        n_experts=4,
+        n_experts_per_token=2,
+        max_seq_len=128,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model preset {name!r}; available: {sorted(PRESETS)}"
+        ) from None

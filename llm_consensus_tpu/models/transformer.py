@@ -1,0 +1,346 @@
+"""Functional pre-norm transformer (Llama/Mistral/Qwen2/Mixtral family).
+
+The reference delegates all model compute to a remote API
+(``src/main.rs:82-86``); this module is its TPU-native replacement per
+BASELINE.json's north star. Design choices are XLA-first:
+
+- **Params are a flat pytree with layers stacked on a leading axis**, and
+  the layer loop is ``lax.scan`` — one traced block, compiled once,
+  regardless of depth (compile time stays flat as n_layers grows).
+- **Static shapes everywhere**: the KV cache is a fixed-size buffer,
+  per-sequence fill state is data (``KVCache.length``), never shape.
+- **bf16 weights/activations, fp32 softmax/norms/logits** — MXU-friendly
+  matmuls with numerically safe reductions.
+- GQA is computed without materializing repeated KV heads
+  (see :mod:`llm_consensus_tpu.ops.attention`).
+- Mixtral-style MoE computes all experts densely and combines with the
+  top-k router weights — correct and simple; the ragged-dispatch
+  optimization is a later kernel (tracked in ops/pallas).
+
+Three entry points:
+- :func:`forward` — full causal forward, logits for every position
+  (training / scoring).
+- :func:`prefill` — fill the KV cache from right-padded prompts, return
+  last-valid-token logits only (avoids a [B, S, V] logits buffer).
+- :func:`decode_step` — one-token step against the cache.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.configs import ModelConfig
+from llm_consensus_tpu.ops.activations import swiglu
+from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
+from llm_consensus_tpu.ops.norms import rms_norm
+from llm_consensus_tpu.ops.rope import apply_rope, rope_cos_sin
+
+
+def _rms(cfg: ModelConfig, x, w):
+    if cfg.use_pallas:
+        from llm_consensus_tpu.ops.pallas import fused_rms_norm
+
+        return fused_rms_norm(x, w, cfg.rms_norm_eps)
+    return rms_norm(x, w, cfg.rms_norm_eps)
+
+
+def _attn_causal(cfg: ModelConfig, q, k, v, positions):
+    # The fused kernel implements index-causal masking; packed/offset
+    # layouts (explicit positions) use the jnp path.
+    if cfg.use_pallas and positions is None and q.shape[1] % _pallas_blk(q.shape[1]) == 0:
+        from llm_consensus_tpu.ops.pallas import flash_causal_attention
+
+        return flash_causal_attention(q, k, v, blk_q=_pallas_blk(q.shape[1]))
+    return causal_attention(q, k, v, positions)
+
+
+def _pallas_blk(s: int) -> int:
+    blk = min(256, s)
+    while s % blk:
+        blk //= 2
+    return max(blk, 1)
+
+
+def _attn_decode(cfg: ModelConfig, q, k_cache, v_cache, valid_len):
+    if cfg.use_pallas:
+        from llm_consensus_tpu.ops.pallas import flash_decode_attention
+
+        return flash_decode_attention(q, k_cache, v_cache, valid_len)
+    return decode_attention(q, k_cache, v_cache, valid_len)
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random-init parameters (truncated-normal-free simple scheme:
+    normal(0, 0.02), residual projections scaled by 1/sqrt(2*n_layers))."""
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    L, D, H, Hkv, F, V = (
+        cfg.n_layers,
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.d_ff,
+        cfg.vocab_size,
+    )
+    Dh = cfg.head_dim
+    resid_scale = 0.02 / math.sqrt(2 * L)
+
+    blocks: dict = {
+        "attn_norm": jnp.ones((L, D), dtype),
+        "mlp_norm": jnp.ones((L, D), dtype),
+        "wq": normal(next(keys), (L, D, H * Dh)),
+        "wk": normal(next(keys), (L, D, Hkv * Dh)),
+        "wv": normal(next(keys), (L, D, Hkv * Dh)),
+        "wo": normal(next(keys), (L, H * Dh, D), resid_scale),
+    }
+    if cfg.qkv_bias:
+        blocks["bq"] = jnp.zeros((L, H * Dh), dtype)
+        blocks["bk"] = jnp.zeros((L, Hkv * Dh), dtype)
+        blocks["bv"] = jnp.zeros((L, Hkv * Dh), dtype)
+    if cfg.is_moe:
+        E = cfg.n_experts
+        blocks["router"] = normal(next(keys), (L, D, E))
+        blocks["w_gate"] = normal(next(keys), (L, E, D, F))
+        blocks["w_up"] = normal(next(keys), (L, E, D, F))
+        blocks["w_down"] = normal(next(keys), (L, E, F, D), resid_scale)
+    else:
+        blocks["w_gate"] = normal(next(keys), (L, D, F))
+        blocks["w_up"] = normal(next(keys), (L, D, F))
+        blocks["w_down"] = normal(next(keys), (L, F, D), resid_scale)
+
+    params = {
+        "embed": normal(next(keys), (V, D)),
+        "blocks": blocks,
+        "norm_f": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(keys), (D, V))
+    return params
+
+
+def param_count(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+
+def _project_qkv(cfg: ModelConfig, p: dict, h: jnp.ndarray):
+    b, s, _ = h.shape
+    q = h @ p["wq"]
+    k = h @ p["wk"]
+    v = h @ p["wv"]
+    if cfg.qkv_bias:
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+    q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
+    if not cfg.is_moe:
+        return swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
+    # Mixtral MoE: top-k routing, dense all-experts compute, weighted combine.
+    router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, S, E]
+    top_vals, top_idx = jax.lax.top_k(router_logits, cfg.n_experts_per_token)
+    top_w = jax.nn.softmax(top_vals, axis=-1)  # [B, S, k]
+    # combine weights scattered back over the expert axis: [B, S, E]
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32)
+        * top_w[..., None],
+        axis=-2,
+    )
+    gate = jax.nn.silu(jnp.einsum("bsd,edf->bsef", h, p["w_gate"]))
+    up = jnp.einsum("bsd,edf->bsef", h, p["w_up"])
+    expert_out = jnp.einsum("bsef,efd->bsed", gate * up, p["w_down"])
+    return jnp.einsum(
+        "bsed,bse->bsd", expert_out, combine.astype(expert_out.dtype)
+    )
+
+
+def _block(
+    cfg: ModelConfig,
+    p: dict,
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    k_layer: jnp.ndarray | None,
+    v_layer: jnp.ndarray | None,
+    mode: str,
+    valid_len: jnp.ndarray | None,
+    positions: jnp.ndarray | None,
+):
+    """One transformer block. Returns (x, new_k_layer, new_v_layer)."""
+    h = _rms(cfg, x, p["attn_norm"])
+    q, k, v = _project_qkv(cfg, p, h)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if mode == "full":
+        attn = _attn_causal(cfg, q, k, v, positions)
+        new_k = new_v = None
+    elif mode == "prefill":
+        attn = _attn_causal(cfg, q, k, v, positions)
+        s = k.shape[1]
+        new_k = k_layer.at[:, :s].set(k.astype(k_layer.dtype))
+        new_v = v_layer.at[:, :s].set(v.astype(v_layer.dtype))
+    elif mode == "decode":
+        b = x.shape[0]
+        batch_idx = jnp.arange(b)
+        # valid_len is the pre-write fill length; write the new token there.
+        new_k = k_layer.at[batch_idx, valid_len].set(
+            k[:, 0].astype(k_layer.dtype)
+        )
+        new_v = v_layer.at[batch_idx, valid_len].set(
+            v[:, 0].astype(v_layer.dtype)
+        )
+        attn = _attn_decode(cfg, q, new_k, new_v, valid_len + 1)
+    else:  # pragma: no cover
+        raise ValueError(mode)
+
+    x = x + attn.reshape(*x.shape[:-1], -1) @ p["wo"]
+    h2 = _rms(cfg, x, p["mlp_norm"])
+    x = x + _mlp(cfg, p, h2)
+    return x, new_k, new_v
+
+
+def _run_layers(
+    cfg: ModelConfig,
+    params: dict,
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    cache: KVCache | None,
+    mode: str,
+    valid_len: jnp.ndarray | None,
+    positions: jnp.ndarray | None,
+    remat: bool = False,
+):
+    """lax.scan over the stacked layer axis."""
+    blocks = params["blocks"]
+
+    if mode == "full":
+
+        def body(carry, p):
+            y, _, _ = _block(cfg, p, carry, cos, sin, None, None, "full", None, positions)
+            return y, None
+
+        if remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, blocks)
+        return x, cache
+
+    def body(carry, layer_in):
+        p, k_l, v_l = layer_in
+        y, nk, nv = _block(
+            cfg, p, carry, cos, sin, k_l, v_l, mode, valid_len, positions
+        )
+        return y, (nk, nv)
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, (new_k, new_v) = jax.lax.scan(body, x, (blocks, cache.k, cache.v))
+    return x, KVCache(k=new_k, v=new_v, length=cache.length)
+
+
+def _unembed(cfg: ModelConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    x = _rms(cfg, x, params["norm_f"])
+    w = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("...d,dv->...v", x, w, preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Full causal forward: tokens [B, S] -> logits [B, S, V] (float32)."""
+    x = params["embed"][tokens]
+    if positions is None:
+        positions_arr = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
+    else:
+        positions_arr = positions
+    cos, sin = rope_cos_sin(positions_arr, cfg.head_dim, cfg.rope_theta)
+    x, _ = _run_layers(
+        cfg, params, x, cos, sin, None, "full", None, positions, remat=remat
+    )
+    return _unembed(cfg, params, x)
+
+
+def prefill(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill right-padded prompts.
+
+    tokens: [B, S] right-padded; lengths: [B] true prompt lengths.
+    Returns (last-valid-token logits [B, V] float32, cache with k/v written
+    at slots [0, S) and length set to ``lengths``).
+
+    Padded slots do write garbage k/v into the cache, but they sit at
+    indices >= lengths[b] and are (a) masked out of every later decode
+    step's attention (``valid_len`` masking) and (b) progressively
+    overwritten by decode writes at slot ``length``.
+    """
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    x, cache = _run_layers(
+        cfg, params, x, cos, sin, cache, "prefill", None, None
+    )
+    # Gather hidden state at the last real token of each sequence.
+    b = tokens.shape[0]
+    last = jnp.clip(lengths - 1, 0, tokens.shape[1] - 1)
+    x_last = x[jnp.arange(b), last]  # [B, D]
+    logits = _unembed(cfg, params, x_last)
+    return logits, cache.with_length(lengths)
+
+
+def decode_step(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step: tokens [B, 1] -> (logits [B, V] float32, new cache).
+
+    The new token's k/v is written at slot ``cache.length`` and the fill
+    length advances by one.
+    """
+    x = params["embed"][tokens]  # [B, 1, D]
+    positions = cache.length[:, None]  # [B, 1]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    x, cache = _run_layers(
+        cfg, params, x, cos, sin, cache, "decode", cache.length, None
+    )
+    logits = _unembed(cfg, params, x[:, 0])
+    return logits, cache.advanced(1)

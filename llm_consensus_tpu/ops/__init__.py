@@ -1,0 +1,13 @@
+from llm_consensus_tpu.ops.norms import rms_norm
+from llm_consensus_tpu.ops.rope import apply_rope, rope_cos_sin
+from llm_consensus_tpu.ops.activations import swiglu
+from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_cos_sin",
+    "swiglu",
+    "causal_attention",
+    "decode_attention",
+]

@@ -1,0 +1,25 @@
+"""SwiGLU MLP.
+
+jnp implementation; the two up-projections and the gate multiply are a
+single fused region under XLA on TPU (the matmuls land on the MXU, the
+silu*gate elementwise fuses into the second matmul's prologue).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(
+    x: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+) -> jnp.ndarray:
+    """SwiGLU feed-forward: silu(x @ w_gate) * (x @ w_up) @ w_down.
+
+    x: [..., d_model]; w_gate/w_up: [d_model, d_ff]; w_down: [d_ff, d_model].
+    """
+    gate = jax.nn.silu(x @ w_gate)
+    return (gate * (x @ w_up)) @ w_down

@@ -1,0 +1,92 @@
+"""Attention: causal prefill and single-step decode against a KV cache.
+
+Reference counterpart: none (the reference's compute is a remote API call,
+``src/main.rs:82-86``); BASELINE.json's north star requires native attention
+for the TPU candidate-sampling hot loop. The jnp path here is the
+XLA-compiled baseline; :mod:`llm_consensus_tpu.ops.pallas` provides the
+flash-style kernels that replace it on the hot path.
+
+Conventions:
+- q/k/v are [B, S, H, D] / [B, S, Hkv, D]; GQA groups are expanded by
+  broadcasting (no materialized repeat: the einsum indexes kv heads).
+- Softmax runs in float32; outputs are cast back to the input dtype.
+- Masks are additive-free boolean `where` selects (XLA folds them).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _gqa_scores(q: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Scores [B, Hkv, G, Sq, Sk] where H = Hkv * G (GQA without repeat)."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    return jnp.einsum("bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32)
+
+
+def _gqa_out(probs: jnp.ndarray, v: jnp.ndarray, dtype) -> jnp.ndarray:
+    b, hkv, g, sq, sk = probs.shape
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", probs, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, sq, hkv * g, -1).astype(dtype)
+
+
+def causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Causal self-attention over a full (prefill) sequence.
+
+    q: [B, S, H, D]; k/v: [B, S, Hkv, D] with H a multiple of Hkv (GQA).
+    positions: optional [B, S] integer positions; when given, key j attends
+    to query i iff pos_j <= pos_i (supports packed/offset layouts). Default
+    is index-causal.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = _gqa_scores(q, k) * scale  # [B, Hkv, G, Sq, Sk] fp32
+    sq, sk = scores.shape[-2], scores.shape[-1]
+    if positions is None:
+        qi = jnp.arange(sq)[:, None]
+        kj = jnp.arange(sk)[None, :]
+        mask = kj <= qi  # [Sq, Sk]
+        mask = mask[None, None, None]
+    else:
+        qi = positions[:, :, None]  # [B, Sq, 1]
+        kj = positions[:, None, :]  # [B, 1, Sk]
+        mask = (kj <= qi)[:, None, None]  # [B, 1, 1, Sq, Sk]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    return _gqa_out(probs, v, q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    valid_len: jnp.ndarray,
+) -> jnp.ndarray:
+    """One-token decode attention against a fixed-size KV cache.
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, max_len, Hkv, D];
+    valid_len: [B] number of valid cache slots per sequence (the new token's
+    k/v must already be written; slots >= valid_len are masked out).
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = _gqa_scores(q, k_cache) * scale  # [B, Hkv, G, 1, max_len]
+    max_len = k_cache.shape[1]
+    slot = jnp.arange(max_len)[None, :]  # [1, max_len]
+    mask = (slot < valid_len[:, None])[:, None, None, None]  # [B,1,1,1,max_len]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    return _gqa_out(probs, v_cache, q.dtype)

@@ -1,0 +1,27 @@
+"""RMSNorm.
+
+The reference has no compute kernels at all (its "device layer" is a remote
+HTTPS call, ``src/main.rs:82-86``); per BASELINE.json's north star the TPU
+build supplies RMSNorm natively. The default path is plain jnp — XLA fuses
+the reduction + scale into surrounding ops on TPU — with an optional Pallas
+kernel (:mod:`llm_consensus_tpu.ops.pallas.rmsnorm`) for the fused
+norm+scale hot path in decode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Root-mean-square layer norm (Llama convention: scale only, no bias).
+
+    The reduction runs in float32 regardless of input dtype (bf16 activations
+    would lose precision in the mean-of-squares), and the result is cast back.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,24 @@
+"""Pallas TPU kernels for the inference hot loop.
+
+The reference has no native compute of its own (its FLOPs live behind the
+Gemini API, ``src/main.rs:82-86``); these kernels are the TPU build's
+"native op" layer per SURVEY.md §7 step 1 — fused attention (prefill and
+cached decode) and RMSNorm that keep the softmax pipeline in VMEM instead
+of round-tripping score matrices through HBM.
+
+On non-TPU backends the kernels run in Pallas interpret mode (tests), and
+every wrapper has a jnp reference twin in :mod:`llm_consensus_tpu.ops`
+used for numerics cross-checks.
+"""
+
+from llm_consensus_tpu.ops.pallas.attention import (
+    flash_causal_attention,
+    flash_decode_attention,
+)
+from llm_consensus_tpu.ops.pallas.norms import fused_rms_norm
+
+__all__ = [
+    "flash_causal_attention",
+    "flash_decode_attention",
+    "fused_rms_norm",
+]

@@ -1,0 +1,230 @@
+"""Fused attention kernels (Pallas/Mosaic).
+
+Two kernels, mirroring the two jnp reference paths in
+:mod:`llm_consensus_tpu.ops.attention`:
+
+- :func:`flash_causal_attention` — prefill/full attention. Grid over
+  (batch x kv-head, query blocks); each program holds its (b, kv) K/V
+  slab in VMEM, computes a [G*blk_q, S] score tile in fp32 on the MXU,
+  applies the causal mask, does the softmax in VMEM, and writes the
+  [G*blk_q, D] output — the score matrix never touches HBM.
+- :func:`flash_decode_attention` — single-token decode against the KV
+  cache with per-sequence ``valid_len`` masking (the ragged-decode op of
+  BASELINE.json's north star). Grid over (batch, kv-head).
+
+GQA layout: H = Hkv * G query heads share each kv head; programs are
+per-(batch, kv-head) and process all G group heads at once, so K/V are
+read exactly once per program (no repeated-KV materialization anywhere).
+
+Tiling: D (head_dim) and S pad to lane width (128); fp32 accumulation via
+``preferred_element_type``. On CPU tests, ``interpret=True`` is selected
+automatically (same kernels, interpreted).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Prefill / full causal attention
+# ---------------------------------------------------------------------------
+
+
+def _causal_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, scale: float):
+    """One (b, kv-head, q-block) program.
+
+    q_ref: [1, blk_q, G, D]; k_ref/v_ref: [1, S, D]; o_ref: [1, blk_q, G, D].
+    """
+    qi = pl.program_id(1)
+    _, _, g, d = q_ref.shape
+    s = k_ref.shape[1]
+
+    q = q_ref[0].astype(jnp.float32)  # [blk_q, G, D]
+    q2 = q.reshape(blk_q * g, d)
+    k = k_ref[0]  # [S, D]
+    scores = jax.lax.dot_general(
+        q2,
+        k.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [blk_q*G, S]
+    scores = scores.reshape(blk_q, g, s)
+
+    q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, 1, 1), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s), 2)
+    scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = (p / denom).reshape(blk_q * g, s)
+
+    out = jax.lax.dot_general(
+        p,
+        v_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [blk_q*G, D]
+    o_ref[0] = out.reshape(blk_q, g, d).astype(o_ref.dtype)
+
+
+def flash_causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    blk_q: int = 256,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal attention, index-causal positions (the prefill hot path).
+
+    q: [B, S, H, D]; k/v: [B, S, Hkv, D]. S must divide by ``blk_q``
+    (callers pad prompts to buckets, ``engine.EngineConfig.seq_buckets``).
+    Returns [B, S, H, D] in q's dtype.
+    """
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    blk_q = min(blk_q, s)
+    if s % blk_q:
+        raise ValueError(f"seq len {s} not divisible by q block {blk_q}")
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+
+    # [B, S, Hkv, G, D] -> per-(b, kv) programs see [blk_q, G, D] q tiles.
+    q5 = q.reshape(b, s, hkv, g, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    q5 = q5.transpose(0, 2, 1, 3, 4).reshape(b * hkv, s, g, d)
+
+    out = pl.pallas_call(
+        functools.partial(_causal_kernel, blk_q=blk_q, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, s, g, d), q.dtype),
+        grid=(b * hkv, s // blk_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, blk_q, g, d),
+                lambda bh, qi: (bh, qi, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, s, d), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, s, d), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, g, d),
+            lambda bh, qi: (bh, qi, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        interpret=interpret,
+    )(q5, kt, vt)
+    # [B*Hkv, S, G, D] -> [B, S, H, D]
+    return (
+        out.reshape(b, hkv, s, g, d).transpose(0, 2, 1, 3, 4).reshape(b, s, h, d)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Decode attention against the KV cache
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
+    """One (batch, kv-head) program.
+
+    len_ref: [1] SMEM valid length; q_ref: [1, 1, G, D];
+    k_ref/v_ref: [1, S, D]; o_ref: [1, 1, G, D].
+    """
+    _, _, g, d = q_ref.shape
+    s = k_ref.shape[1]
+    valid = len_ref[0]
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+    scores = jax.lax.dot_general(
+        q,
+        k_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [G, S]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
+    scores = jnp.where(slot < valid, scores, _NEG_INF)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+
+    out = jax.lax.dot_general(
+        p,
+        v_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [G, D]
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """One-token decode attention with ragged valid lengths.
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, max_len, Hkv, D];
+    valid_len: [B] int32. Returns [B, 1, H, D] in q's dtype.
+    """
+    b, _, h, d = q.shape
+    s = k_cache.shape[1]
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+
+    q4 = q.reshape(b, 1, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b * hkv, 1, g, d
+    )
+    kt = k_cache.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vt = v_cache.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    lens = jnp.repeat(valid_len.astype(jnp.int32), hkv)  # [B*Hkv]
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, 1, g, d), q.dtype),
+        grid=(b * hkv,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda bh: (bh,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (1, 1, g, d), lambda bh: (bh, 0, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, s, d), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, s, d), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda bh: (bh, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(lens, q4, kt, vt)
+    return (
+        out.reshape(b, hkv, 1, g, d).transpose(0, 2, 1, 3, 4).reshape(b, 1, h, d)
+    )

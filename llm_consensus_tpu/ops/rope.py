@@ -1,0 +1,49 @@
+"""Rotary position embeddings (RoPE), rotate-half convention.
+
+Replaces the RoPE the BASELINE.json north star attributes to the target's
+CUDA path; here it is jnp (XLA fuses the elementwise rotation into the
+surrounding projections on TPU). Frequencies are computed on the fly from
+integer positions so decode steps with per-sequence offsets need no
+precomputed table.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray, head_dim: int, theta: float = 10000.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for given integer positions.
+
+    positions: [...] int array (any shape, e.g. [B, S]).
+    Returns cos, sin of shape [..., head_dim] (half-frequencies duplicated,
+    matching the rotate-half convention).
+    """
+    half = head_dim // 2
+    freq_exponents = jnp.arange(half, dtype=jnp.float32) / half
+    inv_freq = 1.0 / (theta**freq_exponents)  # [half]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., half]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [..., head_dim]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Apply rotary embedding to q or k.
+
+    x: [B, S, H, D]; cos/sin: [B, S, D] (broadcast over the head axis).
+    Rotation runs in float32 and is cast back to x.dtype.
+    """
+    xf = x.astype(jnp.float32)
+    c = cos[..., None, :]  # [B, S, 1, D]
+    s = sin[..., None, :]
+    return (xf * c + _rotate_half(xf) * s).astype(x.dtype)

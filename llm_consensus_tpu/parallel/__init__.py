@@ -1,0 +1,34 @@
+"""Parallelism: device meshes, sharding rules, and sequence parallelism.
+
+The reference's only "parallelism" is request-level concurrency over HTTP
+futures on one actix arbiter (``src/main.rs:101,156,182,250-253``) — no
+DP/TP/EP/SP and no distributed backend (SURVEY.md §2). This package
+supplies the real thing, the TPU way: a named ``jax.sharding.Mesh``
+(data/model/expert/seq axes), ``PartitionSpec`` rules for every param and
+activation, GSPMD-inserted XLA collectives over ICI/DCN, and ring
+attention for long-context sequence parallelism.
+"""
+
+from llm_consensus_tpu.parallel.mesh import (
+    MeshConfig,
+    best_mesh_for,
+    make_mesh,
+)
+from llm_consensus_tpu.parallel.partitioning import (
+    batch_pspec,
+    cache_pspecs,
+    param_pspecs,
+    shard_params,
+)
+from llm_consensus_tpu.parallel.ring import ring_attention
+
+__all__ = [
+    "MeshConfig",
+    "best_mesh_for",
+    "batch_pspec",
+    "cache_pspecs",
+    "make_mesh",
+    "param_pspecs",
+    "ring_attention",
+    "shard_params",
+]

@@ -1,0 +1,88 @@
+"""Device-mesh construction with the framework's canonical axis names.
+
+Axes (any may be size 1; all shardings in
+:mod:`llm_consensus_tpu.parallel.partitioning` are written against them):
+
+- ``data``   — candidate / batch fan-out (self-consistency N, panel rows).
+  Weights are replicated across it; the KV cache shards along it
+  (BASELINE.json north star).
+- ``model``  — tensor parallelism (attention heads, MLP hidden).
+- ``expert`` — expert parallelism for MoE (Mixtral config).
+- ``seq``    — sequence/context parallelism (ring attention).
+
+On real hardware ``jax.devices()`` supplies the TPU slice; tests create
+the same meshes over ``xla_force_host_platform_device_count`` CPU
+devices — the sharded programs are identical either way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "model", "expert", "seq")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    data: int = 1
+    model: int = 1
+    expert: int = 1
+    seq: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.model * self.expert * self.seq
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "model": self.model,
+            "expert": self.expert,
+            "seq": self.seq,
+        }
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a 4-axis mesh. Default: all devices on ``data``.
+
+    Axis order is (data, model, expert, seq) — ``model`` and ``seq`` are
+    innermost-adjacent so TP/ring collectives ride the fastest ICI links
+    when the runtime's device order is physically contiguous.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(data=len(devices))
+    if config.size != len(devices):
+        raise ValueError(
+            f"mesh {config} needs {config.size} devices, got {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(
+        config.data, config.model, config.expert, config.seq
+    )
+    return Mesh(arr, AXES)
+
+
+def best_mesh_for(
+    n_devices: int,
+    *,
+    want_model: int = 1,
+    want_expert: int = 1,
+    want_seq: int = 1,
+) -> MeshConfig:
+    """Fill the requested inner axes, spend the remainder on ``data``."""
+    inner = want_model * want_expert * want_seq
+    if n_devices % inner != 0:
+        raise ValueError(
+            f"{n_devices} devices not divisible by model*expert*seq={inner}"
+        )
+    return MeshConfig(
+        data=n_devices // inner,
+        model=want_model,
+        expert=want_expert,
+        seq=want_seq,
+    )

@@ -1,0 +1,104 @@
+"""PartitionSpec rules: how every param/activation maps onto the mesh.
+
+The sharding recipe (scaling-book style): pick a mesh
+(:mod:`llm_consensus_tpu.parallel.mesh`), annotate every array with a
+``PartitionSpec`` against the named axes, and let GSPMD insert the
+collectives — all-gathers/psums ride ICI. No hand-written NCCL-equivalent
+calls anywhere (the reference has none to port either; its comms layer is
+in-process actix mailboxes, SURVEY.md §2).
+
+Tensor-parallel layout (Megatron-style, expressed declaratively):
+- qkv projections column-sharded over ``model`` (heads split);
+- attention output row-sharded over ``model`` (GSPMD inserts the psum);
+- MLP gate/up column-sharded, down row-sharded;
+- MoE experts sharded over ``expert`` with each expert's FFN additionally
+  TP-sharded over ``model``;
+- lm_head vocab-sharded; logits gather at the end.
+The KV cache shards batch over ``data`` and kv heads over ``model``
+(BASELINE.json north star: per-candidate cache sharding in HBM).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+# Rules keyed by param-leaf name. Each value is the PartitionSpec for that
+# leaf in the ``init_params`` tree (llm_consensus_tpu.models.transformer).
+# Dense (non-MoE) block weights:
+_DENSE_RULES: dict[str, P] = {
+    "embed": P(None, None),  # gather table; replicate (V small vs FLOPs)
+    "norm_f": P(None),
+    "lm_head": P(None, "model"),  # vocab-sharded logits
+    "attn_norm": P(None, None),
+    "mlp_norm": P(None, None),
+    "wq": P(None, None, "model"),
+    "wk": P(None, None, "model"),
+    "wv": P(None, None, "model"),
+    "wo": P(None, "model", None),
+    "bq": P(None, "model"),
+    "bk": P(None, "model"),
+    "bv": P(None, "model"),
+    "w_gate": P(None, None, "model"),
+    "w_up": P(None, None, "model"),
+    "w_down": P(None, "model", None),
+}
+# MoE block weights override (leading expert axis after the layer axis).
+_MOE_RULES: dict[str, P] = {
+    "router": P(None, None, None),
+    "w_gate": P(None, "expert", None, "model"),
+    "w_up": P(None, "expert", None, "model"),
+    "w_down": P(None, "expert", "model", None),
+}
+
+
+def _leaf_name(path) -> str:
+    for entry in reversed(path):
+        if isinstance(entry, jax.tree_util.DictKey):
+            return entry.key
+    raise ValueError(f"no named key in path {path}")
+
+
+def param_pspecs(params) -> dict:
+    """PartitionSpec tree mirroring an ``init_params`` tree."""
+
+    def rule(path, leaf):
+        name = _leaf_name(path)
+        if name in _MOE_RULES and leaf.ndim == len(_MOE_RULES[name]):
+            return _MOE_RULES[name]
+        if name in _DENSE_RULES:
+            spec = _DENSE_RULES[name]
+            if leaf.ndim != len(spec):
+                raise ValueError(
+                    f"param {name!r} rank {leaf.ndim} != rule rank {len(spec)}"
+                )
+            return spec
+        raise ValueError(f"no sharding rule for param {name!r}")
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def cache_pspecs() -> "object":
+    """Specs for a KVCache pytree: batch over ``data``, kv heads over
+    ``model`` — per-candidate cache sharding (BASELINE.json north star)."""
+    from llm_consensus_tpu.models.cache import KVCache
+
+    return KVCache(
+        k=P(None, "data", None, "model", None),
+        v=P(None, "data", None, "model", None),
+        length=P("data"),
+    )
+
+
+def batch_pspec() -> P:
+    """Token/length batches shard their leading axis over ``data``."""
+    return P("data")
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a param tree on the mesh per :func:`param_pspecs`."""
+    specs = param_pspecs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )

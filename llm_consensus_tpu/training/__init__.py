@@ -1,0 +1,26 @@
+"""Training: sharded causal-LM train step (persona tuning substrate).
+
+The reference conditions personas purely by prompt ("tuning" strings,
+``src/main.rs:359-426``) and trains nothing. The TPU framework supplies a
+real training path — fine-tuning persona/panel models is how domain
+conditioning scales past prompt engineering — and the same sharded train
+step is the multi-chip dry-run surface (``__graft_entry__.dryrun_multichip``).
+"""
+
+from llm_consensus_tpu.training.train import (
+    TrainConfig,
+    TrainState,
+    causal_lm_loss,
+    make_optimizer,
+    make_sharded_train_step,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainConfig",
+    "TrainState",
+    "causal_lm_loss",
+    "make_optimizer",
+    "make_sharded_train_step",
+    "make_train_step",
+]

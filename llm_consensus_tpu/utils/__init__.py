@@ -1,0 +1,12 @@
+"""Utilities: logging, tracing/profiling, deterministic RNG streams.
+
+The reference's observability is ``log``+``env_logger`` only, and its
+only timing is the REPL poll pacing (SURVEY.md §5). Here: structured
+span tracing with wall-clock + optional JAX profiler integration, and
+RUST_LOG-convention logging setup.
+"""
+
+from llm_consensus_tpu.utils.logging import setup_logging
+from llm_consensus_tpu.utils.tracing import Tracer, span, trace_jax_profile
+
+__all__ = ["Tracer", "setup_logging", "span", "trace_jax_profile"]

@@ -1,0 +1,43 @@
+"""Logging setup — env_logger parity.
+
+The reference initializes ``env_logger`` (``src/main.rs:352``) and
+controls verbosity with ``RUST_LOG``; here ``LLM_CONSENSUS_LOG`` plays
+that role (same convention: a level name, optionally ``module=level``
+pairs separated by commas).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_FORMAT = "[%(asctime)s %(levelname)s %(name)s] %(message)s"
+
+
+def setup_logging(spec: str | None = None) -> None:
+    """Configure logging from a RUST_LOG-style spec.
+
+    ``spec`` defaults to ``$LLM_CONSENSUS_LOG`` (then ``info``).
+    Examples: ``debug``, ``info,llm_consensus_tpu.consensus=debug``.
+    """
+    spec = spec if spec is not None else os.environ.get("LLM_CONSENSUS_LOG", "info")
+    root_level = logging.INFO
+    module_levels: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            mod, _, lvl = part.partition("=")
+            level = getattr(logging, lvl.strip().upper(), None)
+            if isinstance(level, int):
+                module_levels[mod.strip()] = level
+        else:
+            level = getattr(logging, part.upper(), None)
+            if isinstance(level, int):
+                root_level = level
+    # force: reconfigure on repeat calls (basicConfig is otherwise a no-op
+    # once a handler exists, so level changes would silently not apply).
+    logging.basicConfig(level=root_level, format=_FORMAT, force=True)
+    for mod, level in module_levels.items():
+        logging.getLogger(mod).setLevel(level)

@@ -1,0 +1,108 @@
+"""Span tracing + JAX profiler hooks.
+
+Tracing is ~absent in the reference (wall-clock only paces the readiness
+poll, ``src/main.rs:449-454``; SURVEY.md §5). Here:
+
+- :class:`Tracer` / :func:`span` — lightweight wall-clock spans recorded
+  as structured events (name, start, duration, metadata), queryable and
+  dumpable to JSON; protocol phases (propose/evaluate/refine) and engine
+  phases (prefill/decode) report through this.
+- :func:`trace_jax_profile` — context manager around
+  ``jax.profiler.trace`` producing a TensorBoard-loadable device trace
+  for the real TPU hot loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SpanRecord:
+    name: str
+    start: float
+    duration: float
+    meta: dict = field(default_factory=dict)
+
+
+class Tracer:
+    """Collects timed spans; thread-safe (backend calls run in threads)."""
+
+    def __init__(self) -> None:
+        self._records: list[SpanRecord] = []
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            with self._lock:
+                self._records.append(
+                    SpanRecord(name=name, start=t0, duration=dur, meta=meta)
+                )
+
+    @property
+    def records(self) -> list[SpanRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def total(self, name: str) -> float:
+        return sum(r.duration for r in self.records if r.name == name)
+
+    def summary(self) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for r in self.records:
+            agg = out.setdefault(
+                r.name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            agg["count"] += 1
+            agg["total_s"] += r.duration
+            agg["max_s"] = max(agg["max_s"], r.duration)
+        return out
+
+    def dump_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                [
+                    {
+                        "name": r.name,
+                        "start": r.start,
+                        "duration": r.duration,
+                        **({"meta": r.meta} if r.meta else {}),
+                    }
+                    for r in self.records
+                ],
+                f,
+            )
+
+
+_GLOBAL = Tracer()
+
+
+def span(name: str, **meta):
+    """Span on the process-global tracer."""
+    return _GLOBAL.span(name, **meta)
+
+
+def global_tracer() -> Tracer:
+    return _GLOBAL
+
+
+@contextlib.contextmanager
+def trace_jax_profile(logdir: str):
+    """Capture a JAX/XLA device profile (TensorBoard format) around a
+    block — the real profiling story for the TPU hot loop."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

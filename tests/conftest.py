@@ -10,14 +10,29 @@ Must run before the first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment preimports jax (sitecustomize) and
+# registers a real-TPU tunnel backend whose initialization blocks on the
+# (single, shared) chip. Tests must never contend for it, and the
+# multi-device tests need the 8 simulated CPU devices below. Because jax
+# is already imported before this file runs, the env var alone is not
+# enough — flip the live config too, before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# This environment's default matmul precision truncates fp32 matmuls to
+# bf16 passes; numerics tests compare against exact numpy references, so
+# pin full precision for the test process only (production keeps the fast
+# default — bf16 on the MXU is the intended TPU path).
+jax.config.update("jax_default_matmul_precision", "highest")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,63 @@
+"""CLI / REPL driver tests (reference L4, ``src/main.rs:428-471``)."""
+
+import json
+
+import pytest
+
+from llm_consensus_tpu.cli import build_parser, main
+
+
+def test_parser_defaults():
+    args = build_parser().parse_args([])
+    assert args.backend == "fake"
+    assert args.max_rounds == 5  # reference hard-codes 5 (src/main.rs:299)
+    assert args.question is None
+
+
+def test_one_shot_question_fake_backend(capsys):
+    rc = main(["--backend", "fake", "--question", "What is 2+2?", "--seed", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "What is 2+2?" in out  # FakeBackend echoes the question
+
+
+def test_panel_file_roundtrip(tmp_path, capsys):
+    from llm_consensus_tpu.consensus.personas import default_panel, save_panel
+
+    panel_file = tmp_path / "panel.json"
+    save_panel(default_panel()[:2], panel_file)
+    rc = main(
+        ["--backend", "fake", "--panel", str(panel_file), "--question", "hi"]
+    )
+    assert rc == 0
+
+
+def test_eval_requires_local_backend(capsys):
+    rc = main(["--backend", "fake", "--eval-gsm8k", "synthetic"])
+    assert rc == 2
+
+
+def test_repl_loop_exit(monkeypatch, capsys):
+    """REPL parity: prompts 'Enter a question: ', answers, 'exit' quits."""
+    import asyncio
+    import io
+
+    from llm_consensus_tpu.backends.fake import FakeBackend
+    from llm_consensus_tpu.cli import repl
+    from llm_consensus_tpu.consensus.coordinator import (
+        Coordinator,
+        CoordinatorConfig,
+    )
+    from llm_consensus_tpu.consensus.personas import default_panel
+
+    answers = iter(["What is up?\n", "exit\n"])
+    monkeypatch.setattr(
+        "sys.stdin", type("S", (), {"readline": lambda self: next(answers)})()
+    )
+    coord = Coordinator(
+        default_panel(), FakeBackend(), CoordinatorConfig(seed=0)
+    )
+    asyncio.run(repl(coord))
+    out = capsys.readouterr().out
+    assert out.count("Enter a question: ") == 2
+    assert "What is up?" in out

@@ -1,0 +1,164 @@
+"""Numerics tests for core ops against naive reference implementations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
+from llm_consensus_tpu.ops.norms import rms_norm
+from llm_consensus_tpu.ops.rope import apply_rope, rope_cos_sin
+from llm_consensus_tpu.ops.activations import swiglu
+
+
+def test_rms_norm_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, 16)).astype(np.float32)
+    w = rng.standard_normal(16).astype(np.float32)
+    eps = 1e-5
+    expected = x / np.sqrt((x**2).mean(-1, keepdims=True) + eps) * w
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=2e-4, atol=2e-5)
+
+
+def test_rms_norm_preserves_dtype():
+    x = jnp.ones((2, 8), jnp.bfloat16)
+    w = jnp.ones((8,), jnp.bfloat16)
+    assert rms_norm(x, w).dtype == jnp.bfloat16
+
+
+def test_rope_preserves_norm():
+    # Rotation must not change vector norms per frequency pair.
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (1, 6, 2, 8))
+    positions = jnp.arange(6)[None, :]
+    cos, sin = rope_cos_sin(positions, 8)
+    y = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rope_relative_property():
+    # <rope(q,m), rope(k,n)> depends only on m-n.
+    key1, key2 = jax.random.split(jax.random.PRNGKey(2))
+    q = jax.random.normal(key1, (1, 1, 1, 16))
+    k = jax.random.normal(key2, (1, 1, 1, 16))
+
+    def dot_at(m, n):
+        cm, sm = rope_cos_sin(jnp.array([[m]]), 16)
+        cn, sn = rope_cos_sin(jnp.array([[n]]), 16)
+        qm = apply_rope(q, cm, sm)
+        kn = apply_rope(k, cn, sn)
+        return float(jnp.sum(qm * kn))
+
+    assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+    assert dot_at(0, 0) == pytest.approx(dot_at(7, 7), rel=1e-4)
+
+
+def test_rope_position_zero_is_identity():
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 2, 8))
+    cos, sin = rope_cos_sin(jnp.zeros((1, 1), jnp.int32), 8)
+    np.testing.assert_allclose(
+        np.asarray(apply_rope(x, cos, sin)), np.asarray(x), rtol=1e-6
+    )
+
+
+def _naive_attention(q, k, v):
+    """Naive causal attention with explicit GQA repeat, numpy."""
+    q, k, v = map(np.asarray, (q, k, v))
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    rep = h // hkv
+    k = np.repeat(k, rep, axis=2)
+    v = np.repeat(v, rep, axis=2)
+    out = np.zeros_like(q)
+    for bi in range(b):
+        for hi in range(h):
+            scores = (q[bi, :, hi] @ k[bi, :, hi].T) / np.sqrt(d)
+            mask = np.tril(np.ones((s, s), bool))
+            scores = np.where(mask, scores, -1e30)
+            p = np.exp(scores - scores.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[bi, :, hi] = p @ v[bi, :, hi]
+    return out
+
+
+def test_causal_attention_matches_naive_gqa():
+    key = jax.random.PRNGKey(4)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 7, 4, 8))
+    k = jax.random.normal(kk, (2, 7, 2, 8))
+    v = jax.random.normal(kv, (2, 7, 2, 8))
+    got = causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), _naive_attention(q, k, v), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_causality_future_keys_ignored():
+    key = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 6, 2, 8))
+    k = jax.random.normal(kk, (1, 6, 2, 8))
+    v = jax.random.normal(kv, (1, 6, 2, 8))
+    out1 = causal_attention(q, k, v)
+    # Perturb the last key/value: all but the final query position unchanged.
+    k2 = k.at[:, -1].add(100.0)
+    v2 = v.at[:, -1].add(100.0)
+    out2 = causal_attention(q, k2, v2)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), rtol=1e-5
+    )
+
+
+def test_decode_attention_matches_causal_last_position():
+    # Decoding the t-th token against a cache of t+1 entries must equal the
+    # last row of full causal attention over t+1 tokens.
+    key = jax.random.PRNGKey(6)
+    kq, kk, kv = jax.random.split(key, 3)
+    s, max_len = 5, 9
+    q_all = jax.random.normal(kq, (1, s, 4, 8))
+    k_all = jax.random.normal(kk, (1, s, 2, 8))
+    v_all = jax.random.normal(kv, (1, s, 2, 8))
+    full = causal_attention(q_all, k_all, v_all)
+
+    k_cache = jnp.zeros((1, max_len, 2, 8)).at[:, :s].set(k_all)
+    v_cache = jnp.zeros((1, max_len, 2, 8)).at[:, :s].set(v_all)
+    got = decode_attention(
+        q_all[:, s - 1 : s], k_cache, v_cache, jnp.array([s])
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:, 0]), np.asarray(full[:, -1]), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_decode_attention_masks_stale_slots():
+    # Slots beyond valid_len must not affect the result.
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 1, 2, 8))
+    k_cache = jax.random.normal(kk, (1, 10, 2, 8))
+    v_cache = jax.random.normal(kv, (1, 10, 2, 8))
+    out1 = decode_attention(q, k_cache, v_cache, jnp.array([4]))
+    k2 = k_cache.at[:, 4:].set(999.0)
+    v2 = v_cache.at[:, 4:].set(-999.0)
+    out2 = decode_attention(q, k2, v2, jnp.array([4]))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-5)
+
+
+def test_swiglu_matches_numpy():
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((2, 3, 8)).astype(np.float32)
+    wg = rng.standard_normal((8, 16)).astype(np.float32)
+    wu = rng.standard_normal((8, 16)).astype(np.float32)
+    wd = rng.standard_normal((16, 8)).astype(np.float32)
+
+    def silu(z):
+        return z / (1 + np.exp(-z))
+
+    expected = (silu(x @ wg) * (x @ wu)) @ wd
+    got = swiglu(jnp.asarray(x), jnp.asarray(wg), jnp.asarray(wu), jnp.asarray(wd))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=2e-4, atol=2e-4)

@@ -1,0 +1,40 @@
+"""Tests for logging setup and span tracing."""
+
+import json
+import logging
+
+from llm_consensus_tpu.utils.logging import setup_logging
+from llm_consensus_tpu.utils.tracing import Tracer
+
+
+def test_setup_logging_levels():
+    setup_logging("debug")
+    assert logging.getLogger().level == logging.DEBUG
+    setup_logging("warning,llm_consensus_tpu.consensus=debug")
+    assert logging.getLogger().level == logging.WARNING
+    assert (
+        logging.getLogger("llm_consensus_tpu.consensus").level == logging.DEBUG
+    )
+    setup_logging("bogus-level")  # falls back to info, no crash
+    assert logging.getLogger().level == logging.INFO
+
+
+def test_tracer_spans_and_summary(tmp_path):
+    tr = Tracer()
+    with tr.span("evaluate", round=1):
+        with tr.span("decode"):
+            pass
+    with tr.span("decode"):
+        pass
+    assert len(tr.records) == 3
+    s = tr.summary()
+    assert s["decode"]["count"] == 2
+    assert s["evaluate"]["count"] == 1
+    assert tr.total("decode") >= 0.0
+
+    out = tmp_path / "trace.json"
+    tr.dump_json(str(out))
+    data = json.loads(out.read_text())
+    assert len(data) == 3
+    assert {d["name"] for d in data} == {"evaluate", "decode"}
+    assert any(d.get("meta") == {"round": 1} for d in data)

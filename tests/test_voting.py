@@ -1,0 +1,137 @@
+"""Tests for answer aggregation: votes, pooling, on-device reducer.
+
+The reference's only aggregation is the unanimity gate
+(``src/main.rs:316-325``), already covered in test_coordinator.py; these
+cover the self-consistency generalizations (BASELINE.md configs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.consensus.voting import (
+    canonicalize,
+    device_majority_vote,
+    extract_final_number,
+    logit_pool,
+    majority_vote,
+    weighted_vote,
+)
+from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+# ---------------------------------------------------------------------------
+# Canonicalization
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("The answer is 42.", "42"),
+        ("#### 1,234", "1234"),
+        ("costs $3.50 total", "3.5"),
+        ("x = 7.0", "7"),
+        ("first 3 then 9", "9"),
+        ("Reasoning... #### 12\n", "12"),
+        ("negative: -5", "-5"),
+        ("no numbers here", None),
+    ],
+)
+def test_extract_final_number(text, expected):
+    assert extract_final_number(text) == expected
+
+
+def test_canonicalize_falls_back_to_text():
+    assert canonicalize("  YES  definitely ") == "yes definitely"
+    assert canonicalize("answer: 10") == "10"
+
+
+# ---------------------------------------------------------------------------
+# Host-side votes
+# ---------------------------------------------------------------------------
+
+
+def test_majority_vote_basic():
+    r = majority_vote(["42", "The answer is 42", "41"])
+    assert r.winner == "42"
+    assert r.tally == {"42": 2.0, "41": 1.0}
+    assert r.n_candidates == 3
+
+
+def test_majority_vote_representative_text():
+    r = majority_vote(["I think 7", "7", "8"])
+    assert r.winner == "7"
+    assert r.text == "I think 7"  # first raw answer with the winning key
+
+
+def test_weighted_vote_overrides_count():
+    r = weighted_vote(["a", "a", "b"], [1.0, 1.0, 5.0])
+    assert r.winner == "b"
+    with pytest.raises(ValueError):
+        weighted_vote(["a"], [1.0, 2.0])
+
+
+def test_logit_pool_prefers_mass():
+    # Two votes for "1" with tiny probability vs one confident "2".
+    r = logit_pool(["1", "1", "2"], [-10.0, -10.0, -0.1])
+    assert r.winner == "2"
+
+
+def test_vote_empty_raises():
+    with pytest.raises(ValueError):
+        majority_vote([])
+
+
+# ---------------------------------------------------------------------------
+# On-device reducer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_device_majority_vote_matches_host():
+    mesh = make_mesh(MeshConfig(data=8))
+    ids = jnp.array([3, 1, 3, 2, 3, 1, 0, 1], jnp.int32)  # 3x'3', 3x'1'
+    winner, hist = device_majority_vote(ids, n_classes=5, mesh=mesh)
+    assert winner == 1  # tie 3 vs 3 -> argmax picks lower id
+    np.testing.assert_array_equal(hist, [1, 3, 1, 3, 0])
+
+    w = jnp.array([1, 1, 1, 1, 1, 1, 1, 0.5], jnp.float32)
+    winner_w, hist_w = device_majority_vote(
+        ids, n_classes=5, mesh=mesh, weights=w
+    )
+    assert winner_w == 3
+    np.testing.assert_allclose(hist_w, [1, 2.5, 1, 3, 0])
+
+
+# ---------------------------------------------------------------------------
+# End-to-end self-consistency on the tiny model
+# ---------------------------------------------------------------------------
+
+
+def test_self_consistency_end_to_end():
+    from llm_consensus_tpu.consensus.voting import self_consistency
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        cfg,
+        params,
+        engine_config=EngineConfig(
+            max_new_tokens=6, seq_buckets=(16,), batch_buckets=(8,)
+        ),
+    )
+    out = self_consistency(eng, "What is 2+2?", n=8, temperature=1.5, seed=3)
+    assert out.vote.n_candidates == 8
+    assert len(out.candidates) == 8
+    assert out.total_tokens >= 8
+    assert out.vote.winner in {canonicalize(c) for c in out.candidates}
+    out2 = self_consistency(
+        eng, "What is 2+2?", n=8, temperature=1.5, seed=3, method="logit_pool"
+    )
+    assert out2.vote.n_candidates == 8
+    with pytest.raises(ValueError):
+        self_consistency(eng, "q", n=2, method="bogus")
