@@ -76,23 +76,45 @@ class InferenceEngine:
         self, prompts: list[str]
     ) -> tuple[np.ndarray, np.ndarray, int]:
         tok = self.tokenizer
-        encoded = [tok.encode(p) for p in prompts]
         # Left-truncate over-long prompts (keep the question tail); the cap
         # is the model context, not just the largest bucket.
         max_prompt = min(self.config.seq_buckets[-1], self.cfg.max_seq_len - 1)
-        encoded = [ids[-max_prompt:] for ids in encoded]
-        longest = max(len(ids) for ids in encoded)
+        native = self._native_encode(prompts, max_prompt)
+        if native is not None:
+            enc_tokens, enc_lengths = native
+        else:
+            encoded = [tok.encode(p)[-max_prompt:] for p in prompts]
+            enc_lengths = np.array([len(ids) for ids in encoded], np.int32)
+            enc_tokens = np.full((len(prompts), max_prompt), tok.pad_id, np.int32)
+            for i, ids in enumerate(encoded):
+                enc_tokens[i, : len(ids)] = ids
+        longest = int(enc_lengths.max())
         s = _next_bucket(longest, self.config.seq_buckets)
         s = min(s, self.cfg.max_seq_len)
-        b = _next_bucket(len(encoded), self.config.batch_buckets)
+        b = _next_bucket(len(prompts), self.config.batch_buckets)
         tokens = np.full((b, s), tok.pad_id, np.int32)
+        w = min(s, enc_tokens.shape[1])  # bucket may exceed the prompt cap
+        tokens[: len(prompts), :w] = enc_tokens[:, :w]
         lengths = np.zeros((b,), np.int32)
-        for i, ids in enumerate(encoded):
-            tokens[i, : len(ids)] = ids
-            lengths[i] = len(ids)
+        lengths[: len(prompts)] = enc_lengths
         # Dummy pad rows get length 1 so gather/clip stay in range.
-        lengths[len(encoded) :] = 1
-        return tokens, lengths, len(encoded)
+        lengths[len(prompts) :] = 1
+        return tokens, lengths, len(prompts)
+
+    def _native_encode(self, prompts, max_prompt):
+        """Batch-encode via the native runtime when the tokenizer is the
+        byte tokenizer and libconsensus_rt is available (one C pass
+        instead of a Python loop per request)."""
+        if type(self.tokenizer) is not ByteTokenizer:
+            return None
+        try:
+            from llm_consensus_tpu.native import available, batch_encode
+
+            if not available():
+                return None
+            return batch_encode(prompts, max_len=max_prompt, add_bos=True)
+        except Exception:  # noqa: BLE001 - any native issue -> python path
+            return None
 
     def generate_texts(
         self,

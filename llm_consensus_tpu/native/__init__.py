@@ -1,0 +1,25 @@
+"""ctypes bindings for the native runtime (native/src/consensus_rt.cpp).
+
+Builds lazily with ``make`` on first use if the shared library is absent
+(g++ is in the image; pybind11 is not, hence ctypes). Everything is
+gated: callers use :func:`available` / :func:`load` and keep a pure-
+Python fallback, so the framework works without the toolchain.
+"""
+
+from llm_consensus_tpu.native.runtime import (
+    NativeLoader,
+    NativeRing,
+    available,
+    batch_encode,
+    batch_decode,
+    load,
+)
+
+__all__ = [
+    "NativeLoader",
+    "NativeRing",
+    "available",
+    "batch_decode",
+    "batch_encode",
+    "load",
+]

@@ -1,0 +1,17 @@
+"""Serving: request scheduler batching concurrent callers onto the device.
+
+The reference serves exactly one question at a time from its REPL
+(``src/main.rs:428-471``) and fans out each panel step as independent
+HTTP futures. Here concurrent producers (REPL sessions, eval harness,
+panel fan-outs) enqueue requests; a scheduler thread drains the queue
+into shape-bucketed batches and runs ONE device program per batch —
+device-batching replaces request concurrency (SURVEY.md §7).
+"""
+
+from llm_consensus_tpu.serving.scheduler import (
+    BatchScheduler,
+    SchedulerConfig,
+    ServingBackend,
+)
+
+__all__ = ["BatchScheduler", "SchedulerConfig", "ServingBackend"]

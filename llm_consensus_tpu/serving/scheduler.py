@@ -1,0 +1,213 @@
+"""Batch scheduler: many concurrent requests -> few device programs.
+
+Design (TPU-first): the expensive resource is a compiled decode program
+over static shapes, so the scheduler's job is to pack concurrent
+requests into shape buckets and keep the chip busy with full batches.
+
+- Producers call :meth:`BatchScheduler.submit` (thread-safe, returns a
+  ``concurrent.futures.Future``).
+- Request metadata rides the native MPMC ring
+  (:class:`llm_consensus_tpu.native.NativeRing`) when libconsensus_rt is
+  built, else a ``queue.Queue`` — same semantics, pure-Python fallback.
+- One scheduler thread drains up to ``max_batch`` requests per cycle
+  (with a short linger so near-simultaneous panel fan-outs coalesce into
+  one program), groups them by sampling config, runs
+  ``InferenceEngine.generate_texts`` once per group, and resolves the
+  futures.
+
+The reference has no scheduler at all — its concurrency is unbounded
+per-request HTTP futures (``src/main.rs:101,156,182``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+from llm_consensus_tpu.backends.base import (
+    Backend,
+    BackendError,
+    GenerationRequest,
+    GenerationResult,
+)
+from llm_consensus_tpu.engine.engine import InferenceEngine
+from llm_consensus_tpu.engine.sampler import SamplerConfig
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SchedulerConfig:
+    max_batch: int = 64
+    # Linger: after the first request arrives, wait this long for more to
+    # coalesce (panel fan-outs land together; one program instead of N).
+    linger_s: float = 0.004
+    ring_capacity: int = 1024
+    use_native_ring: bool = True
+
+
+@dataclass
+class _Pending:
+    request: GenerationRequest
+    future: Future = field(default_factory=Future)
+
+
+class BatchScheduler:
+    """Thread-safe request batcher over one engine."""
+
+    def __init__(
+        self, engine: InferenceEngine, config: SchedulerConfig | None = None
+    ):
+        self.engine = engine
+        self.config = config or SchedulerConfig()
+        self._pending: dict[int, _Pending] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._queue = self._make_queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="batch-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+
+    def _make_queue(self):
+        if self.config.use_native_ring:
+            try:
+                from llm_consensus_tpu.native import NativeRing, available
+
+                if available():
+                    return NativeRing(self.config.ring_capacity)
+            except Exception:  # noqa: BLE001
+                pass
+        return queue.Queue(maxsize=self.config.ring_capacity)
+
+    def _q_push(self, item: dict) -> None:
+        payload = json.dumps(item).encode()
+        if isinstance(self._queue, queue.Queue):
+            self._queue.put(payload)
+        else:
+            self._queue.push(payload)
+
+    def _q_pop(self, timeout: float | None) -> dict | None:
+        if isinstance(self._queue, queue.Queue):
+            try:
+                payload = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                return None
+        else:
+            payload = self._queue.pop(timeout=timeout)
+            if payload is None:
+                return None
+        return json.loads(payload)
+
+    # ------------------------------------------------------------------
+
+    def submit(self, request: GenerationRequest) -> Future:
+        """Enqueue one request; the Future resolves to GenerationResult."""
+        if self._stop.is_set():
+            raise RuntimeError("scheduler stopped")
+        pend = _Pending(request=request)
+        with self._lock:
+            rid = next(self._ids)
+            self._pending[rid] = pend
+        self._q_push({"id": rid})
+        return pend.future
+
+    def close(self) -> None:
+        self._stop.set()
+        if not isinstance(self._queue, queue.Queue):
+            self._queue.close()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        cfg = self.config
+        while not self._stop.is_set():
+            first = self._q_pop(timeout=0.05)
+            if first is None:
+                continue
+            batch_ids = [first["id"]]
+            deadline = time.perf_counter() + cfg.linger_s
+            while len(batch_ids) < cfg.max_batch:
+                left = deadline - time.perf_counter()
+                nxt = self._q_pop(timeout=max(left, 0)) if left > 0 else None
+                if nxt is None:
+                    break
+                batch_ids.append(nxt["id"])
+            self._execute(batch_ids)
+        # Drain on shutdown: fail any still-pending futures.
+        with self._lock:
+            for pend in self._pending.values():
+                if not pend.future.done():
+                    pend.future.set_exception(BackendError("scheduler stopped"))
+            self._pending.clear()
+
+    def _execute(self, batch_ids: list[int]) -> None:
+        with self._lock:
+            pends = [
+                (rid, self._pending.pop(rid))
+                for rid in batch_ids
+                if rid in self._pending
+            ]
+        if not pends:
+            return
+        # Group by static sampling config (one compiled program each).
+        groups: dict[tuple, list[tuple[int, _Pending]]] = {}
+        for rid, pend in pends:
+            p = pend.request.params
+            groups.setdefault(
+                (p.max_new_tokens, p.top_k, p.top_p), []
+            ).append((rid, pend))
+        for (max_new, top_k, top_p), members in groups.items():
+            reqs = [pend.request for _, pend in members]
+            try:
+                outs = self.engine.generate_texts(
+                    [r.prompt for r in reqs],
+                    temperatures=[r.params.temperature for r in reqs],
+                    seed=reqs[0].params.seed,
+                    max_new_tokens=max_new,
+                    sampler=SamplerConfig(top_k=top_k, top_p=top_p),
+                )
+            except Exception as e:  # noqa: BLE001
+                log.error("scheduler batch failed: %s", e)
+                for _, pend in members:
+                    if not pend.future.done():
+                        pend.future.set_exception(
+                            BackendError(f"batch execution failed: {e}")
+                        )
+                continue
+            for (_, pend), out in zip(members, outs):
+                pend.future.set_result(
+                    GenerationResult(
+                        text=out.text,
+                        num_tokens=out.num_tokens,
+                        logprob=out.logprob,
+                    )
+                )
+
+
+class ServingBackend(Backend):
+    """Backend seam over a shared :class:`BatchScheduler` — multiple
+    coordinators/eval harnesses share one chip efficiently."""
+
+    def __init__(self, scheduler: BatchScheduler):
+        self.scheduler = scheduler
+
+    async def generate_batch(
+        self, requests: list[GenerationRequest]
+    ) -> list[GenerationResult]:
+        import asyncio
+
+        futures = [self.scheduler.submit(r) for r in requests]
+        return await asyncio.gather(
+            *(asyncio.wrap_future(f) for f in futures)
+        )

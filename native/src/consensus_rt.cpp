@@ -1,0 +1,263 @@
+// consensus_rt: native runtime for the TPU consensus framework.
+//
+// The reference's only native code is its Rust application itself (one
+// actix binary; SURVEY.md §2 — no CUDA/C++ compute). This library is the
+// rebuild's host-side runtime: the pieces around the XLA device programs
+// that want real threads and no GIL —
+//
+//   1. batch tokenizer  — byte-level encode/decode (id = byte + 3, ids
+//      0/1/2 = pad/bos/eos, mirroring engine/tokenizer.py) over request
+//      batches, one pass, no Python loop;
+//   2. request ring     — bounded MPMC queue for the serving scheduler
+//      (REPL/eval producers -> device-batch consumer), condvar-based;
+//   3. token data loader — mmap'd int32 token shards + background
+//      prefetch thread producing fixed-shape [B, S] training batches.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 1. Batch byte tokenizer (ids: 0=pad, 1=bos, 2=eos, byte b -> b+3)
+// ---------------------------------------------------------------------------
+
+// Encode n texts into a right-padded [n, max_len] int32 buffer.
+// Over-long texts keep their TAIL (same left-truncation the engine does).
+// lengths[i] receives the true (post-truncation) token count.
+// Returns 0 on success.
+int rt_byte_encode_batch(const char** texts, const int64_t* text_lens,
+                         int32_t n, int32_t* out, int32_t max_len,
+                         int32_t* lengths, int32_t add_bos) {
+  if (n < 0 || max_len <= 0) return -1;
+  for (int32_t i = 0; i < n; ++i) {
+    const unsigned char* t =
+        reinterpret_cast<const unsigned char*>(texts[i]);
+    int64_t tl = text_lens[i];
+    int32_t* row = out + static_cast<int64_t>(i) * max_len;
+    int64_t total = tl + (add_bos ? 1 : 0);
+    int64_t skip = total > max_len ? total - max_len : 0;  // drop head
+    int32_t w = 0;
+    if (add_bos && skip == 0) row[w++] = 1;  // bos survives only untruncated
+    // Bytes to skip from the text head:
+    int64_t byte_skip = skip > 0 ? skip - (add_bos ? 1 : 0) : 0;
+    for (int64_t j = byte_skip; j < tl && w < max_len; ++j)
+      row[w++] = static_cast<int32_t>(t[j]) + 3;
+    lengths[i] = w;
+    for (int32_t j = w; j < max_len; ++j) row[j] = 0;  // pad
+  }
+  return 0;
+}
+
+// Decode one id row (stops at eos or len); writes at most cap bytes.
+// Returns number of bytes written, or -1 on error.
+int64_t rt_byte_decode(const int32_t* ids, int64_t len, char* out,
+                       int64_t cap) {
+  int64_t w = 0;
+  for (int64_t i = 0; i < len; ++i) {
+    int32_t id = ids[i];
+    if (id == 2) break;             // eos
+    if (id < 3 || id > 258) continue;  // pad/bos/out-of-range
+    if (w >= cap) return -1;
+    out[w++] = static_cast<char>(id - 3);
+  }
+  return w;
+}
+
+// ---------------------------------------------------------------------------
+// 2. Bounded MPMC request ring (serving scheduler queue)
+// ---------------------------------------------------------------------------
+
+struct RtRing {
+  explicit RtRing(int64_t cap) : capacity(cap), closed(false) {}
+  int64_t capacity;
+  std::deque<std::vector<uint8_t>> items;
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::condition_variable not_full;
+  bool closed;
+};
+
+void* rt_ring_create(int64_t capacity) {
+  if (capacity <= 0) return nullptr;
+  return new RtRing(capacity);
+}
+
+void rt_ring_destroy(void* h) { delete static_cast<RtRing*>(h); }
+
+// Push a payload; blocks while full unless timeout_ms >= 0 expires.
+// Returns 0 ok, 1 timeout, 2 closed.
+int rt_ring_push(void* h, const uint8_t* data, int64_t len,
+                 int64_t timeout_ms) {
+  auto* r = static_cast<RtRing*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] {
+    return r->closed || (int64_t)r->items.size() < r->capacity;
+  };
+  if (timeout_ms < 0) {
+    r->not_full.wait(lk, pred);
+  } else if (!r->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return 1;
+  }
+  if (r->closed) return 2;
+  r->items.emplace_back(data, data + len);
+  r->not_empty.notify_one();
+  return 0;
+}
+
+// Pop into out (cap bytes). On success stores size into *len and returns 0;
+// 1 timeout, 2 closed-and-empty, 3 payload larger than cap.
+int rt_ring_pop(void* h, uint8_t* out, int64_t cap, int64_t* len,
+                int64_t timeout_ms) {
+  auto* r = static_cast<RtRing*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] { return r->closed || !r->items.empty(); };
+  if (timeout_ms < 0) {
+    r->not_empty.wait(lk, pred);
+  } else if (!r->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return 1;
+  }
+  if (r->items.empty()) return 2;  // closed and drained
+  auto& front = r->items.front();
+  if ((int64_t)front.size() > cap) return 3;
+  *len = (int64_t)front.size();
+  std::memcpy(out, front.data(), front.size());
+  r->items.pop_front();
+  r->not_full.notify_one();
+  return 0;
+}
+
+int64_t rt_ring_size(void* h) {
+  auto* r = static_cast<RtRing*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return (int64_t)r->items.size();
+}
+
+void rt_ring_close(void* h) {
+  auto* r = static_cast<RtRing*>(h);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->not_empty.notify_all();
+  r->not_full.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// 3. mmap token data loader with prefetch thread
+// ---------------------------------------------------------------------------
+
+struct RtLoader {
+  int fd = -1;
+  const int32_t* tokens = nullptr;  // mmap'd
+  int64_t n_tokens = 0;
+  int64_t batch = 0, seq = 0;
+  std::mt19937_64 rng;
+  // Prefetch ring of ready batches.
+  std::deque<std::vector<int32_t>> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  int64_t prefetch_depth = 4;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+
+  void fill_one(std::vector<int32_t>& buf) {
+    // Random contiguous windows — the standard LM pretraining sampler.
+    std::uniform_int_distribution<int64_t> dist(0, n_tokens - seq - 1);
+    for (int64_t b = 0; b < batch; ++b) {
+      int64_t start = dist(rng);
+      std::memcpy(buf.data() + b * seq, tokens + start,
+                  sizeof(int32_t) * seq);
+    }
+  }
+
+  void run() {
+    while (!stop.load()) {
+      std::vector<int32_t> buf(batch * seq);
+      fill_one(buf);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] {
+        return stop.load() || (int64_t)ready.size() < prefetch_depth;
+      });
+      if (stop.load()) return;
+      ready.emplace_back(std::move(buf));
+      cv_ready.notify_one();
+    }
+  }
+};
+
+void* rt_loader_create(const char* path, int64_t batch, int64_t seq,
+                       uint64_t seed) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)(sizeof(int32_t) * (seq + 1))) {
+    close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* l = new RtLoader();
+  l->fd = fd;
+  l->tokens = static_cast<const int32_t*>(map);
+  l->n_tokens = st.st_size / (int64_t)sizeof(int32_t);
+  l->batch = batch;
+  l->seq = seq;
+  l->rng.seed(seed);
+  l->worker = std::thread([l] { l->run(); });
+  return l;
+}
+
+// Blocks until a [batch, seq] int32 batch is ready; copies it into out.
+int rt_loader_next(void* h, int32_t* out) {
+  auto* l = static_cast<RtLoader*>(h);
+  std::unique_lock<std::mutex> lk(l->mu);
+  l->cv_ready.wait(lk, [&] { return l->stop.load() || !l->ready.empty(); });
+  if (l->ready.empty()) return 1;
+  auto buf = std::move(l->ready.front());
+  l->ready.pop_front();
+  l->cv_space.notify_one();
+  lk.unlock();
+  std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+  return 0;
+}
+
+void rt_loader_destroy(void* h) {
+  auto* l = static_cast<RtLoader*>(h);
+  l->stop.store(true);
+  l->cv_space.notify_all();
+  l->cv_ready.notify_all();
+  if (l->worker.joinable()) l->worker.join();
+  if (l->tokens)
+    munmap(const_cast<int32_t*>(l->tokens),
+           (size_t)l->n_tokens * sizeof(int32_t));
+  if (l->fd >= 0) close(l->fd);
+  delete l;
+}
+
+int64_t rt_loader_n_tokens(void* h) {
+  return static_cast<RtLoader*>(h)->n_tokens;
+}
+
+const char* rt_version() { return "consensus_rt 0.1"; }
+
+}  // extern "C"

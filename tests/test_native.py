@@ -1,0 +1,142 @@
+"""Native runtime (libconsensus_rt.so): tokenizer, ring, data loader.
+
+Skipped wholesale when the toolchain can't build the library — every
+native consumer has a pure-Python fallback, which other tests cover.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.native import available
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native runtime not built"
+)
+
+
+# ---------------------------------------------------------------------------
+# Batch tokenizer parity with the Python ByteTokenizer
+# ---------------------------------------------------------------------------
+
+
+def test_batch_encode_matches_python_tokenizer():
+    from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+    from llm_consensus_tpu.native import batch_encode
+
+    tok = ByteTokenizer()
+    texts = ["hello", "ünïcödé ☃", "", "a" * 50]
+    out, lens = batch_encode(texts, max_len=32)
+    for i, t in enumerate(texts):
+        expected = tok.encode(t)[-32:]
+        assert out[i, : lens[i]].tolist() == expected
+        assert (out[i, lens[i] :] == tok.pad_id).all()
+
+
+def test_batch_encode_tail_truncation_matches_python():
+    from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+    from llm_consensus_tpu.native import batch_encode
+
+    tok = ByteTokenizer()
+    long = "x" * 100 + "TAIL"
+    out, lens = batch_encode([long], max_len=16)
+    assert out[0, : lens[0]].tolist() == tok.encode(long)[-16:]
+
+
+def test_batch_decode_roundtrip_and_eos_stop():
+    from llm_consensus_tpu.native import batch_decode, batch_encode
+
+    out, lens = batch_encode(["roundtrip text", "second"], max_len=32, add_bos=False)
+    texts = batch_decode(out)
+    assert texts == ["roundtrip text", "second"]
+    # EOS (id 2) stops the row decode.
+    row = np.array([[104 + 3, 105 + 3, 2, 106 + 3]], np.int32)
+    assert batch_decode(row) == ["hi"]
+
+
+# ---------------------------------------------------------------------------
+# Request ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_fifo_and_timeout():
+    from llm_consensus_tpu.native import NativeRing
+
+    r = NativeRing(2)
+    assert r.push(b"a") and r.push(b"b")
+    assert not r.push(b"c", timeout=0.02)  # full
+    assert r.pop() == b"a"
+    assert r.pop() == b"b"
+    assert r.pop(timeout=0.02) is None  # empty
+
+
+def test_ring_cross_thread_blocking_pop():
+    from llm_consensus_tpu.native import NativeRing
+
+    r = NativeRing(4)
+    got = []
+
+    def consumer():
+        got.append(r.pop(timeout=2.0))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    r.push(b"payload")
+    t.join(timeout=3)
+    assert got == [b"payload"]
+
+
+def test_ring_close_unblocks():
+    from llm_consensus_tpu.native import NativeRing
+
+    r = NativeRing(1)
+    results = []
+
+    def consumer():
+        results.append(r.pop(timeout=5.0))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    r.close()
+    t.join(timeout=3)
+    assert results == [None]
+
+
+# ---------------------------------------------------------------------------
+# Data loader
+# ---------------------------------------------------------------------------
+
+
+def test_loader_contiguous_windows(tmp_path):
+    from llm_consensus_tpu.native import NativeLoader
+
+    shard = tmp_path / "shard.bin"
+    np.arange(5000, dtype=np.int32).tofile(shard)
+    ld = NativeLoader(shard, batch=4, seq=16, seed=7)
+    assert ld.n_tokens == 5000
+    for _ in range(5):
+        b = ld.next()
+        assert b.shape == (4, 16)
+        assert (np.diff(b, axis=1) == 1).all()  # contiguous window
+        assert (b >= 0).all() and (b < 5000).all()
+    ld.close()
+
+
+def test_loader_deterministic_by_seed(tmp_path):
+    from llm_consensus_tpu.native import NativeLoader
+
+    shard = tmp_path / "shard.bin"
+    np.arange(2000, dtype=np.int32).tofile(shard)
+    a = NativeLoader(shard, batch=2, seq=8, seed=3)
+    b = NativeLoader(shard, batch=2, seq=8, seed=3)
+    np.testing.assert_array_equal(a.next(), b.next())
+    a.close()
+    b.close()
+
+
+def test_loader_missing_file(tmp_path):
+    from llm_consensus_tpu.native import NativeLoader
+
+    with pytest.raises(FileNotFoundError):
+        NativeLoader(tmp_path / "nope.bin", batch=1, seq=8)
