@@ -8,7 +8,24 @@ from llm_consensus_tpu.consensus.coordinator import (
     CoordinatorConfig,
     ConsensusResult,
 )
-from llm_consensus_tpu.consensus.personas import Persona, default_panel
+from llm_consensus_tpu.consensus.personas import (
+    Persona,
+    default_panel,
+    load_panel,
+    save_panel,
+)
+from llm_consensus_tpu.consensus.debate import (
+    DebateConfig,
+    DebateResult,
+    run_debate,
+)
+from llm_consensus_tpu.consensus.voting import (
+    VoteResult,
+    logit_pool,
+    majority_vote,
+    self_consistency,
+    weighted_vote,
+)
 
 __all__ = [
     "AnswerEvaluation",
@@ -17,6 +34,16 @@ __all__ = [
     "Coordinator",
     "CoordinatorConfig",
     "ConsensusResult",
+    "DebateConfig",
+    "DebateResult",
     "Persona",
+    "VoteResult",
     "default_panel",
+    "load_panel",
+    "logit_pool",
+    "majority_vote",
+    "run_debate",
+    "save_panel",
+    "self_consistency",
+    "weighted_vote",
 ]

@@ -1,0 +1,140 @@
+"""Multi-round debate / Tree-of-Thoughts with iterative re-vote.
+
+BASELINE.md config[4]: "Multi-round debate / ToT N=32 with iterative
+re-vote". This generalizes the reference's single-answer refine loop
+(one random dissenter rewrites the one shared answer,
+``src/main.rs:268-286``) to N parallel candidates that *see each other's
+answers* and revise — all N revisions per round are ONE batched device
+program, and the vote after every round is the standard
+self-consistency reducer (:mod:`llm_consensus_tpu.consensus.voting`).
+
+Protocol per round r:
+  1. every candidate i revises its answer given the question, its own
+     previous answer, and a digest of the other candidates' answers
+     (debate conditioning);
+  2. answers are canonicalized and voted; the tally is recorded;
+  3. early exit when a super-majority (``quorum`` fraction) agrees —
+     otherwise continue to the round cap (bounded like the reference's
+     5-round cap, ``src/main.rs:299-300``, but configurable).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from llm_consensus_tpu.consensus.voting import (
+    VoteResult,
+    canonicalize,
+    majority_vote,
+)
+
+
+@dataclass(frozen=True)
+class DebateConfig:
+    n_candidates: int = 8
+    max_rounds: int = 3
+    temperature: float = 0.8
+    # Stop once the leading answer holds at least this fraction of votes.
+    quorum: float = 0.75
+    max_new_tokens: int | None = None
+    # How many peer answers each candidate sees per round (digest size;
+    # keeps prompts bounded at large N).
+    peer_sample: int = 4
+    seed: int = 0
+
+
+@dataclass
+class DebateRound:
+    answers: list[str]
+    vote: VoteResult
+
+
+@dataclass
+class DebateResult:
+    answer: str
+    vote: VoteResult
+    rounds: list[DebateRound] = field(default_factory=list)
+    total_tokens: int = 0
+
+    @property
+    def n_rounds(self) -> int:
+        return len(self.rounds)
+
+
+_INITIAL = (
+    "Answer the question. Think step by step, then state your final "
+    "answer on the last line.\n\nQuestion: {q}\nAnswer:"
+)
+_REVISE = (
+    "You are candidate {i} in a panel debate answering a question.\n"
+    "Question: {q}\n\nYour current answer:\n{own}\n\n"
+    "Other candidates' answers:\n{peers}\n\n"
+    "Reconsider. If another answer is better reasoned, adopt it; "
+    "otherwise defend yours. State your final answer on the last "
+    "line.\nRevised answer:"
+)
+
+
+def run_debate(
+    engine,
+    question: str,
+    config: DebateConfig | None = None,
+    key_fn=canonicalize,
+) -> DebateResult:
+    """Drive one question through multi-round debate on an engine.
+
+    ``engine`` is an :class:`~llm_consensus_tpu.engine.engine.InferenceEngine`
+    (or anything with its ``generate_texts`` surface). Each round is one
+    batched call — N is the data-parallel candidate axis on the mesh.
+    """
+    cfg = config or DebateConfig()
+    n = cfg.n_candidates
+    rounds: list[DebateRound] = []
+    total_tokens = 0
+
+    prompts = [_INITIAL.format(q=question)] * n
+    answers: list[str] = []
+    for r in range(cfg.max_rounds):
+        results = engine.generate_texts(
+            prompts,
+            temperatures=[cfg.temperature] * n,
+            seed=cfg.seed + r,
+            max_new_tokens=cfg.max_new_tokens,
+        )
+        answers = [res.text for res in results]
+        total_tokens += sum(res.num_tokens for res in results)
+        vote = majority_vote(answers, key_fn)
+        rounds.append(DebateRound(answers=answers, vote=vote))
+        lead = max(vote.tally.values()) / max(sum(vote.tally.values()), 1e-9)
+        if lead >= cfg.quorum:
+            break
+        if r + 1 < cfg.max_rounds:
+            prompts = [
+                _REVISE.format(
+                    i=i,
+                    q=question,
+                    own=answers[i],
+                    peers=_peer_digest(answers, i, cfg.peer_sample),
+                )
+                for i in range(n)
+            ]
+
+    final = rounds[-1].vote
+    return DebateResult(
+        answer=final.text,
+        vote=final,
+        rounds=rounds,
+        total_tokens=total_tokens,
+    )
+
+
+def _peer_digest(answers: list[str], own_idx: int, k: int) -> str:
+    """Deterministic round-robin sample of k peers, skipping self."""
+    peers = [a for j, a in enumerate(answers) if j != own_idx]
+    # Rotate by own index so different candidates see different subsets.
+    if peers:
+        off = own_idx % len(peers)
+        peers = (peers[off:] + peers[:off])[:k]
+    return "\n---\n".join(
+        f"[{j + 1}] {p}" for j, p in enumerate(peers)
+    )

@@ -1,0 +1,91 @@
+"""Training data pipeline: token shards -> [B, S] device batches.
+
+Feeds :func:`llm_consensus_tpu.training.train.make_train_step`. Uses the
+native mmap/prefetch loader (:class:`llm_consensus_tpu.native.NativeLoader`)
+when libconsensus_rt is built, else an equivalent pure-numpy sampler.
+Shards are raw little-endian int32 token files (see
+:func:`write_token_shard`). The reference has no data/training pipeline
+at all (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+
+def write_token_shard(path: str | os.PathLike, tokens: np.ndarray) -> None:
+    """Write a 1-D int32 token array as a raw shard file."""
+    np.ascontiguousarray(tokens, np.int32).tofile(path)
+
+
+class TokenBatchLoader:
+    """Random contiguous [batch, seq] windows from a token shard.
+
+    Iterating yields ``(tokens, loss_mask)`` numpy pairs ready for the
+    train step (mask is all-ones; document-boundary masking can be
+    layered on by storing EOS tokens in the shard).
+    """
+
+    def __init__(
+        self,
+        path: str | os.PathLike,
+        batch: int,
+        seq: int,
+        seed: int = 0,
+        prefer_native: bool = True,
+    ):
+        self.path = Path(path)
+        self.batch, self.seq = batch, seq
+        self._native = None
+        if prefer_native:
+            try:
+                from llm_consensus_tpu.native import NativeLoader, available
+
+                if available():
+                    self._native = NativeLoader(self.path, batch, seq, seed)
+            except FileNotFoundError:
+                raise
+            except Exception:  # noqa: BLE001 - build/toolchain issues
+                self._native = None
+        if self._native is None:
+            self._tokens = np.fromfile(self.path, np.int32)
+            if self._tokens.size < seq + 1:
+                raise ValueError(
+                    f"shard {path} has {self._tokens.size} tokens < seq+1"
+                )
+            self._rng = np.random.default_rng(seed)
+
+    @property
+    def native(self) -> bool:
+        return self._native is not None
+
+    @property
+    def n_tokens(self) -> int:
+        if self._native is not None:
+            return self._native.n_tokens
+        return int(self._tokens.size)
+
+    def next(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._native is not None:
+            toks = self._native.next()
+        else:
+            starts = self._rng.integers(
+                0, self._tokens.size - self.seq, size=self.batch
+            )
+            toks = np.stack(
+                [self._tokens[s : s + self.seq] for s in starts]
+            )
+        mask = np.ones_like(toks, np.float32)
+        return toks, mask
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None

@@ -1,0 +1,59 @@
+"""Training data pipeline tests (native and numpy fallback paths)."""
+
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.training.data import TokenBatchLoader, write_token_shard
+
+
+@pytest.fixture()
+def shard(tmp_path):
+    path = tmp_path / "tokens.bin"
+    write_token_shard(path, np.arange(4096, dtype=np.int32))
+    return path
+
+
+@pytest.mark.parametrize("prefer_native", [True, False])
+def test_loader_batches(shard, prefer_native):
+    ld = TokenBatchLoader(shard, batch=4, seq=32, seed=1, prefer_native=prefer_native)
+    assert ld.n_tokens == 4096
+    toks, mask = ld.next()
+    assert toks.shape == (4, 32) and toks.dtype == np.int32
+    assert mask.shape == (4, 32) and (mask == 1.0).all()
+    assert (np.diff(toks, axis=1) == 1).all()  # contiguous windows
+    ld.close()
+
+
+def test_loader_iteration_and_missing(tmp_path, shard):
+    ld = TokenBatchLoader(shard, batch=2, seq=16, prefer_native=False)
+    it = iter(ld)
+    a, _ = next(it)
+    b, _ = next(it)
+    assert a.shape == b.shape == (2, 16)
+    with pytest.raises((FileNotFoundError, ValueError)):
+        TokenBatchLoader(tmp_path / "missing.bin", batch=1, seq=8)
+
+
+def test_loader_feeds_train_step(shard):
+    import jax
+
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.training.train import (
+        TrainConfig,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = get_config("test-tiny")
+    tcfg = TrainConfig(warmup_steps=1, total_steps=4, remat=False)
+    state = init_train_state(cfg, init_params(cfg, jax.random.PRNGKey(0)), tcfg)
+    step = make_train_step(cfg, tcfg)
+    ld = TokenBatchLoader(shard, batch=2, seq=16, seed=0)
+    for _ in range(2):
+        toks, mask = ld.next()
+        toks = toks % cfg.vocab_size
+        state, loss = step(state, toks, mask)
+    assert int(state.step) == 2
+    assert np.isfinite(float(loss))
+    ld.close()

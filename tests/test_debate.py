@@ -1,0 +1,89 @@
+"""Debate / ToT multi-round re-vote tests (BASELINE.md config[4])."""
+
+import jax
+import pytest
+
+from llm_consensus_tpu.consensus.debate import (
+    DebateConfig,
+    run_debate,
+)
+
+
+class FakeEngine:
+    """Scripted generate_texts: returns per-round canned answers."""
+
+    def __init__(self, rounds):
+        self.rounds = list(rounds)
+        self.calls = []
+
+    def generate_texts(self, prompts, temperatures=None, seed=0, max_new_tokens=None):
+        self.calls.append(list(prompts))
+        answers = self.rounds.pop(0)
+        assert len(answers) == len(prompts)
+
+        class R:
+            def __init__(self, t):
+                self.text = t
+                self.num_tokens = max(len(t.split()), 1)
+                self.logprob = -1.0
+
+        return [R(a) for a in answers]
+
+
+def test_debate_quorum_early_exit():
+    eng = FakeEngine([["answer 7"] * 3 + ["answer 9"]])  # 3/4 = quorum
+    res = run_debate(
+        eng, "What?", DebateConfig(n_candidates=4, max_rounds=3, quorum=0.75)
+    )
+    assert res.n_rounds == 1  # early exit, rounds 2-3 never run
+    assert res.vote.winner == "7"
+    assert res.answer == "answer 7"
+    assert len(eng.calls) == 1
+
+
+def test_debate_runs_to_cap_without_quorum():
+    split = ["1", "2", "3", "4"]  # never converges
+    eng = FakeEngine([split, split, split])
+    res = run_debate(
+        eng, "Q", DebateConfig(n_candidates=4, max_rounds=3, quorum=0.75)
+    )
+    assert res.n_rounds == 3
+    assert len(eng.calls) == 3
+    assert res.total_tokens == 12  # 1 token per answer x 4 x 3
+
+
+def test_debate_revision_prompts_carry_peers():
+    eng = FakeEngine([["A", "B", "C", "D"], ["B", "B", "B", "B"]])
+    res = run_debate(
+        eng, "The question", DebateConfig(n_candidates=4, max_rounds=2)
+    )
+    assert res.n_rounds == 2
+    revise_prompts = eng.calls[1]
+    # Candidate 0's revision prompt contains its own answer and a peer's.
+    assert "The question" in revise_prompts[0]
+    assert "A" in revise_prompts[0]
+    assert any(p in revise_prompts[0] for p in ("B", "C", "D"))
+    assert res.vote.winner == "b"  # unanimity after revision
+
+
+def test_debate_on_real_tiny_engine():
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config("test-tiny")
+    eng = InferenceEngine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0)),
+        engine_config=EngineConfig(
+            max_new_tokens=4, seq_buckets=(64, 128), batch_buckets=(4,)
+        ),
+    )
+    res = run_debate(
+        eng,
+        "2+2?",
+        DebateConfig(n_candidates=4, max_rounds=2, temperature=1.5),
+    )
+    assert 1 <= res.n_rounds <= 2
+    assert isinstance(res.answer, str)
+    assert res.total_tokens >= 4
