@@ -33,6 +33,11 @@ def main() -> int:
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--cpu", action="store_true", help="force CPU backend")
     p.add_argument("--tiny", action="store_true", help="use test-tiny model")
+    p.add_argument(
+        "--no-shared-prefill",
+        action="store_true",
+        help="prefill all N rows instead of broadcasting one prompt's cache",
+    )
     args = p.parse_args()
 
     if args.cpu:
@@ -65,6 +70,8 @@ def main() -> int:
             temps,
             max_new_tokens=args.new_tokens,
             eos_id=-1,  # never stop early: fixed work per run
+            # Self-consistency semantics: N candidates share one prompt.
+            shared_prefill=not args.no_shared_prefill,
         )
         return out.tokens
 

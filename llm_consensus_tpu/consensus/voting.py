@@ -174,6 +174,51 @@ def device_majority_vote(
 
 
 @dataclass
+class PanelVoteResult:
+    vote: VoteResult
+    per_model: dict[str, list[str]]
+    total_tokens: int
+
+
+def heterogeneous_panel_vote(
+    engines: dict[str, tuple[object, float]],
+    prompt: str,
+    n_per_model: int = 4,
+    temperature: float = 0.7,
+    seed: int = 0,
+    max_new_tokens: int | None = None,
+    key_fn=canonicalize,
+) -> PanelVoteResult:
+    """Weighted vote across DIFFERENT models (BASELINE.md config[3]).
+
+    ``engines``: model name -> (engine, vote weight). Each model samples
+    ``n_per_model`` candidates (one batched program per model — models
+    have different weights/meshes so they cannot share a batch); every
+    candidate votes with its model's weight.
+    """
+    answers: list[str] = []
+    weights: list[float] = []
+    per_model: dict[str, list[str]] = {}
+    total_tokens = 0
+    for mi, (name, (engine, weight)) in enumerate(sorted(engines.items())):
+        results = engine.generate_texts(
+            [prompt] * n_per_model,
+            temperatures=[temperature] * n_per_model,
+            seed=seed + mi,
+            max_new_tokens=max_new_tokens,
+        )
+        texts = [r.text for r in results]
+        per_model[name] = texts
+        answers.extend(texts)
+        weights.extend([weight] * len(texts))
+        total_tokens += sum(r.num_tokens for r in results)
+    vote = weighted_vote(answers, weights, key_fn)
+    return PanelVoteResult(
+        vote=vote, per_model=per_model, total_tokens=total_tokens
+    )
+
+
+@dataclass
 class SelfConsistencyResult:
     vote: VoteResult
     candidates: list[str]

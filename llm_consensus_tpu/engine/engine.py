@@ -160,6 +160,9 @@ class InferenceEngine:
         # Clamp so prompt + generation fits the model context.
         mnt = max(1, min(mnt, self.cfg.max_seq_len - tokens.shape[1]))
 
+        # Identical prompts (self-consistency fan-out) prefill once and
+        # broadcast the cache instead of prefetching B copies.
+        shared = n_real == b and len(set(prompts)) == 1 and b > 1
         out: GenerateOutput = generate(
             self.cfg,
             self.params,
@@ -171,6 +174,7 @@ class InferenceEngine:
             sampler=sampler if sampler is not None else self.config.sampler,
             eos_id=self.tokenizer.eos_id,
             pad_id=self.tokenizer.pad_id,
+            shared_prefill=shared,
         )
         toks = np.asarray(out.tokens)
         nums = np.asarray(out.num_tokens)

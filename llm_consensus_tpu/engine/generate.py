@@ -45,6 +45,7 @@ class GenerateOutput:
         "eos_id",
         "pad_id",
         "cache_len",
+        "shared_prefill",
     ),
 )
 def generate(
@@ -60,6 +61,7 @@ def generate(
     eos_id: int = 2,
     pad_id: int = 0,
     cache_len: int | None = None,
+    shared_prefill: bool = False,
 ) -> GenerateOutput:
     """Generate up to ``max_new_tokens`` for a batch of right-padded prompts.
 
@@ -75,8 +77,27 @@ def generate(
             f"cache_len {cache_len} < prompt {s} + max_new_tokens {max_new_tokens}"
         )
 
-    cache = KVCache.create(cfg, b, cache_len)
-    logits, cache = prefill(cfg, params, tokens, lengths, cache)
+    if shared_prefill:
+        # Self-consistency fan-out: all B rows decode from the SAME
+        # prompt, so prefill once at B=1 and broadcast the cache — saves
+        # (B-1)/B of the prefill FLOPs (BASELINE.json's N-way configs).
+        cache1 = KVCache.create(cfg, 1, cache_len)
+        logits1, cache1 = prefill(
+            cfg, params, tokens[:1], lengths[:1], cache1
+        )
+        logits = jnp.broadcast_to(logits1, (b, logits1.shape[-1]))
+        cache = KVCache(
+            k=jnp.broadcast_to(
+                cache1.k, (cache1.k.shape[0], b, *cache1.k.shape[2:])
+            ),
+            v=jnp.broadcast_to(
+                cache1.v, (cache1.v.shape[0], b, *cache1.v.shape[2:])
+            ),
+            length=jnp.broadcast_to(cache1.length, (b,)),
+        )
+    else:
+        cache = KVCache.create(cfg, b, cache_len)
+        logits, cache = prefill(cfg, params, tokens, lengths, cache)
 
     key0 = jax.random.fold_in(key, 0)
     tok0, lp0 = sample_token(logits, key0, temperature, sampler)

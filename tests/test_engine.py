@@ -224,6 +224,30 @@ def test_engine_batch_padding_consistency(tiny):
     assert solo.token_ids == batched.token_ids
 
 
+def test_shared_prefill_matches_per_row_prefill(tiny):
+    """Broadcast-cache fan-out must produce the same tokens as B-way
+    prefill of the identical prompt (greedy, so rows are comparable)."""
+    cfg, params = tiny
+    b = 4
+    tokens = jnp.tile(jnp.array([[5, 9, 13, 17]], jnp.int32), (b, 1))
+    lengths = jnp.full((b,), 4, jnp.int32)
+    kw = dict(max_new_tokens=5, eos_id=-1)
+    ref = generate(
+        cfg, params, tokens, lengths, jax.random.PRNGKey(0), jnp.zeros(b), **kw
+    )
+    got = generate(
+        cfg, params, tokens, lengths, jax.random.PRNGKey(0), jnp.zeros(b),
+        shared_prefill=True, **kw,
+    )
+    assert got.tokens.tolist() == ref.tokens.tolist()
+    # Sampled rows still diverge from each other under shared prefill.
+    hot = generate(
+        cfg, params, tokens, lengths, jax.random.PRNGKey(0),
+        jnp.full((b,), 2.0), shared_prefill=True, **kw,
+    )
+    assert len({tuple(r) for r in hot.tokens.tolist()}) > 1
+
+
 def test_engine_overlong_prompt_truncates(tiny):
     """Prompts beyond the model context are left-truncated, not a crash
     (keeps the question tail)."""

@@ -104,6 +104,58 @@ def test_device_majority_vote_matches_host():
     np.testing.assert_allclose(hist_w, [1, 2.5, 1, 3, 0])
 
 
+def test_heterogeneous_panel_vote_weights_models():
+    """config[3]: candidates from different models vote with their
+    model's weight."""
+    from llm_consensus_tpu.consensus.voting import heterogeneous_panel_vote
+
+    class Scripted:
+        def __init__(self, answer):
+            self.answer = answer
+
+        def generate_texts(self, prompts, temperatures=None, seed=0, max_new_tokens=None):
+            class R:
+                text = self.answer
+                num_tokens = 1
+                logprob = -1.0
+
+            return [R() for _ in prompts]
+
+    out = heterogeneous_panel_vote(
+        {
+            "model-a": (Scripted("42"), 1.0),
+            "model-b": (Scripted("41"), 3.0),  # heavier model wins
+        },
+        "What?",
+        n_per_model=2,
+    )
+    assert out.vote.winner == "41"
+    assert out.vote.tally == {"42": 2.0, "41": 6.0}
+    assert set(out.per_model) == {"model-a", "model-b"}
+    assert out.total_tokens == 4
+
+
+def test_heterogeneous_panel_vote_real_engines():
+    from llm_consensus_tpu.consensus.voting import heterogeneous_panel_vote
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    ec = EngineConfig(max_new_tokens=4, seq_buckets=(16,), batch_buckets=(2,))
+    engines = {}
+    for name in ("test-tiny", "test-tiny-moe"):
+        cfg = get_config(name)
+        engines[name] = (
+            InferenceEngine(
+                cfg, init_params(cfg, jax.random.PRNGKey(0)), engine_config=ec
+            ),
+            1.0,
+        )
+    out = heterogeneous_panel_vote(engines, "2+2?", n_per_model=2, seed=1)
+    assert out.vote.n_candidates == 4
+    assert sum(len(v) for v in out.per_model.values()) == 4
+
+
 # ---------------------------------------------------------------------------
 # End-to-end self-consistency on the tiny model
 # ---------------------------------------------------------------------------
