@@ -4,9 +4,10 @@ The reference's only "parallelism" is request-level concurrency over HTTP
 futures on one actix arbiter (``src/main.rs:101,156,182,250-253``) — no
 DP/TP/EP/SP and no distributed backend (SURVEY.md §2). This package
 supplies the real thing, the TPU way: a named ``jax.sharding.Mesh``
-(data/model/expert/seq axes), ``PartitionSpec`` rules for every param and
-activation, GSPMD-inserted XLA collectives over ICI/DCN, and ring
-attention for long-context sequence parallelism.
+(data/pipe/model/expert/seq axes), ``PartitionSpec`` rules for every
+param and activation, GSPMD-inserted XLA collectives over ICI/DCN, ring
+attention for long-context sequence parallelism, and GPipe-microbatch
+pipeline parallelism over the ``pipe`` axis.
 """
 
 from llm_consensus_tpu.parallel.mesh import (
@@ -20,6 +21,12 @@ from llm_consensus_tpu.parallel.partitioning import (
     param_pspecs,
     shard_params,
 )
+from llm_consensus_tpu.parallel.pipeline import (
+    make_pipeline_forward,
+    make_pipeline_train_step,
+    place_pipeline_params,
+    pp_param_pspecs,
+)
 from llm_consensus_tpu.parallel.ring import ring_attention
 
 __all__ = [
@@ -28,7 +35,11 @@ __all__ = [
     "batch_pspec",
     "cache_pspecs",
     "make_mesh",
+    "make_pipeline_forward",
+    "make_pipeline_train_step",
     "param_pspecs",
+    "place_pipeline_params",
+    "pp_param_pspecs",
     "ring_attention",
     "shard_params",
 ]

@@ -6,6 +6,14 @@ Axes (any may be size 1; all shardings in
 - ``data``   — candidate / batch fan-out (self-consistency N, panel rows).
   Weights are replicated across it; the KV cache shards along it
   (BASELINE.json north star).
+- ``pipe``   — pipeline parallelism (layer stages; GPipe microbatching in
+  :mod:`llm_consensus_tpu.parallel.pipeline`). The *training* schedule is
+  point-to-point neighbour activations plus a scalar loss psum, so it
+  tolerates slow links (DCN in multi-slice) — but note the inference-path
+  caveat in :func:`~llm_consensus_tpu.parallel.pipeline.make_pipeline_forward`
+  (its logits broadcast psums a vocab-sized tensor over ``pipe``) and
+  that redundant per-stage embedding makes embed-gradient cotangents
+  psum over ``pipe`` in training.
 - ``model``  — tensor parallelism (attention heads, MLP hidden).
 - ``expert`` — expert parallelism for MoE (Mixtral config).
 - ``seq``    — sequence/context parallelism (ring attention).
@@ -23,7 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "model", "expert", "seq")
+AXES = ("data", "pipe", "model", "expert", "seq")
 
 
 @dataclass(frozen=True)
@@ -32,14 +40,16 @@ class MeshConfig:
     model: int = 1
     expert: int = 1
     seq: int = 1
+    pipe: int = 1
 
     @property
     def size(self) -> int:
-        return self.data * self.model * self.expert * self.seq
+        return self.data * self.pipe * self.model * self.expert * self.seq
 
     def axis_sizes(self) -> dict[str, int]:
         return {
             "data": self.data,
+            "pipe": self.pipe,
             "model": self.model,
             "expert": self.expert,
             "seq": self.seq,
@@ -62,7 +72,7 @@ def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
             f"mesh {config} needs {config.size} devices, got {len(devices)}"
         )
     arr = np.asarray(devices).reshape(
-        config.data, config.model, config.expert, config.seq
+        config.data, config.pipe, config.model, config.expert, config.seq
     )
     return Mesh(arr, AXES)
 
@@ -73,16 +83,19 @@ def best_mesh_for(
     want_model: int = 1,
     want_expert: int = 1,
     want_seq: int = 1,
+    want_pipe: int = 1,
 ) -> MeshConfig:
     """Fill the requested inner axes, spend the remainder on ``data``."""
-    inner = want_model * want_expert * want_seq
+    inner = want_model * want_expert * want_seq * want_pipe
     if n_devices % inner != 0:
         raise ValueError(
-            f"{n_devices} devices not divisible by model*expert*seq={inner}"
+            f"{n_devices} devices not divisible by "
+            f"pipe*model*expert*seq={inner}"
         )
     return MeshConfig(
         data=n_devices // inner,
         model=want_model,
         expert=want_expert,
         seq=want_seq,
+        pipe=want_pipe,
     )

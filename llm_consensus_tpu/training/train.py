@@ -133,45 +133,62 @@ def make_sharded_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
         )
 
     def place(state: TrainState, tokens, loss_mask):
-        pspecs = param_pspecs(state.params)
-        to_sh = lambda s: NamedSharding(mesh, s)  # noqa: E731
-        param_sh = jax.tree_util.tree_map(
-            to_sh, pspecs, is_leaf=lambda x: isinstance(x, P)
-        )
-        params = jax.tree_util.tree_map(jax.device_put, state.params, param_sh)
-        # Optimizer state: optax moment trees (mu/nu) mirror the params
-        # tree, so an opt-state leaf's key-path *ends with* some param's
-        # key-path — shard it like that param. Everything else (step
-        # counts, scalars) replicates. Matching by path, not shape:
-        # distinct params can share a shape (wq/wo are both [L, D, D])
-        # but need different specs.
-        param_shardings = {
-            tuple(str(k) for k in path): leaf.sharding
-            for path, leaf in jax.tree_util.tree_leaves_with_path(params)
-        }
-        max_depth = max((len(k) for k in param_shardings), default=0)
-
-        def put_opt(path, leaf):
-            keys = tuple(str(k) for k in path)
-            for start in range(max(0, len(keys) - max_depth), len(keys)):
-                sh = param_shardings.get(keys[start:])
-                if sh is not None:
-                    return jax.device_put(leaf, sh)
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-
-        opt_state = jax.tree_util.tree_map_with_path(put_opt, state.opt_state)
         # Batch over `data`, sequence over `seq` (activation/sequence
         # parallelism for training; GSPMD inserts the attention gathers).
-        data_sh = NamedSharding(mesh, P("data", "seq"))
-        return (
-            TrainState(
-                params=params,
-                opt_state=opt_state,
-                step=jax.device_put(state.step, NamedSharding(mesh, P())),
-            ),
-            jax.device_put(tokens, data_sh),
-            jax.device_put(loss_mask, data_sh),
+        return place_train_state(
+            state,
+            mesh,
+            param_pspecs(state.params),
+            batch_spec=P("data", "seq"),
+            batches=(tokens, loss_mask),
         )
 
     jitted = jax.jit(step, donate_argnums=(0,))
     return jitted, place
+
+
+def place_train_state(
+    state: TrainState,
+    mesh: Mesh,
+    pspecs,
+    *,
+    batch_spec: P,
+    batches: tuple,
+):
+    """Place a host TrainState + batch arrays onto the mesh.
+
+    Params follow ``pspecs``. Optimizer state: optax moment trees (mu/nu)
+    mirror the params tree, so an opt-state leaf's key-path *ends with*
+    some param's key-path — shard it like that param. Everything else
+    (step counts, scalars) replicates. Matching by path, not shape:
+    distinct params can share a shape (wq/wo are both [L, D, D]) but need
+    different specs.
+    """
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    params = jax.tree_util.tree_map(jax.device_put, state.params, param_sh)
+    param_shardings = {
+        tuple(str(k) for k in path): leaf.sharding
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+    }
+    max_depth = max((len(k) for k in param_shardings), default=0)
+
+    def put_opt(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for start in range(max(0, len(keys) - max_depth), len(keys)):
+            sh = param_shardings.get(keys[start:])
+            if sh is not None:
+                return jax.device_put(leaf, sh)
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+    opt_state = jax.tree_util.tree_map_with_path(put_opt, state.opt_state)
+    batch_sh = NamedSharding(mesh, batch_spec)
+    placed_state = TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+    )
+    return (placed_state, *(jax.device_put(b, batch_sh) for b in batches))

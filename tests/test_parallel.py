@@ -41,7 +41,13 @@ def test_make_mesh_default_all_data():
 
 def test_make_mesh_shapes_and_validation():
     mesh = make_mesh(MeshConfig(data=2, model=2, seq=2))
-    assert mesh.shape == {"data": 2, "model": 2, "expert": 1, "seq": 2}
+    assert mesh.shape == {
+        "data": 2,
+        "pipe": 1,
+        "model": 2,
+        "expert": 1,
+        "seq": 2,
+    }
     with pytest.raises(ValueError):
         make_mesh(MeshConfig(data=3))
 
