@@ -38,6 +38,12 @@ def main() -> int:
         action="store_true",
         help="prefill all N rows instead of broadcasting one prompt's cache",
     )
+    p.add_argument(
+        "--quant",
+        default="int8",
+        choices=("none", "int8"),
+        help="weight-only quantization (int8 halves decode HBM traffic)",
+    )
     args = p.parse_args()
 
     if args.cpu:
@@ -54,6 +60,10 @@ def main() -> int:
     print(f"[bench] model={cfg.name} device={dev.platform}", file=sys.stderr)
 
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    if args.quant == "int8":
+        from llm_consensus_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
     b, s = args.n_candidates, args.prompt_len
     tokens = jnp.ones((b, s), jnp.int32)
     lengths = jnp.full((b,), s, jnp.int32)
@@ -96,7 +106,7 @@ def main() -> int:
         json.dumps(
             {
                 "metric": f"candidate-tokens/sec/chip ({cfg.name}, N={b}, "
-                f"decode {args.new_tokens} @ prompt {s})",
+                f"decode {args.new_tokens} @ prompt {s}, quant={args.quant})",
                 "value": round(tps_per_chip, 2),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tps_per_chip / 1000.0, 4),

@@ -40,6 +40,9 @@ class EngineConfig:
     # Batch-size buckets (padded up with dummy rows).
     batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
     sampler: SamplerConfig = field(default_factory=SamplerConfig)
+    # "int8" = weight-only per-channel quantization at engine init
+    # (ops/quant.py): halves weight HBM traffic on the decode hot loop.
+    quant: str = "none"
 
 
 @dataclass
@@ -69,6 +72,12 @@ class InferenceEngine:
                 f"vocab {cfg.vocab_size}"
             )
         self.config = engine_config or EngineConfig()
+        if self.config.quant == "int8":
+            from llm_consensus_tpu.ops.quant import quantize_params
+
+            self.params = quantize_params(self.params)
+        elif self.config.quant != "none":
+            raise ValueError(f"unknown quant mode {self.config.quant!r}")
 
     # ------------------------------------------------------------------
 

@@ -15,6 +15,17 @@ from dataclasses import dataclass, replace
 
 
 @dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1-style frequency rescaling (HF rope_scaling type
+    'llama3'). Hashable so ModelConfig stays a valid jit static arg."""
+
+    factor: float
+    low_freq_factor: float
+    high_freq_factor: float
+    original_max_position_embeddings: int
+
+
+@dataclass(frozen=True)
 class ModelConfig:
     name: str
     vocab_size: int
@@ -24,8 +35,11 @@ class ModelConfig:
     n_kv_heads: int
     d_ff: int
     rope_theta: float = 10000.0
+    rope_scaling: RopeScaling | None = None  # Llama-3.1 long-context
     rms_norm_eps: float = 1e-5
     max_seq_len: int = 8192
+    # Sliding-window attention (Mistral): 0 = full causal.
+    sliding_window: int = 0
     qkv_bias: bool = False  # Qwen2 uses bias on q/k/v projections
     tie_embeddings: bool = False
     # MoE (Mixtral): 0 experts = dense MLP.
@@ -70,6 +84,7 @@ PRESETS: dict[str, ModelConfig] = {
         d_ff=14336,
         rope_theta=10000.0,
         max_seq_len=8192,
+        sliding_window=4096,  # Mistral-7B-v0.1 windowed attention
     ),
     "qwen2-7b": ModelConfig(
         name="qwen2-7b",

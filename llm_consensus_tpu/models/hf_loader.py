@@ -1,0 +1,262 @@
+"""Load HuggingFace safetensors checkpoints into the stacked param tree.
+
+The reference has no weights at all (its model is the remote Gemini API,
+``src/main.rs:82-86``); this loader is how the TPU build gets real
+Llama-3 / Mistral / Qwen2 / Mixtral weights (the model families named by
+BASELINE.json's configs) into :mod:`llm_consensus_tpu.models.transformer`'s
+layout:
+
+- HF stores one ``[out, in]`` torch Linear weight per layer per proj;
+  ours are ``[in, out]`` matmul weights stacked on a leading layer axis
+  (one ``lax.scan`` block, SURVEY.md §7 step 1) — so each proj is
+  transposed and the per-layer tensors stacked.
+- HF RoPE uses the rotate-half convention, as does
+  :mod:`llm_consensus_tpu.ops.rope` — weights map 1:1, no permutation.
+- bf16 tensors cross torch→numpy via a uint16 view (numpy itself has no
+  bfloat16; ml_dtypes supplies the dtype on the jax side).
+
+Streaming: tensors are read shard-by-shard and released as soon as each
+stacked layer tensor is assembled, so peak host memory stays ~1 model
+copy at target dtype.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_consensus_tpu.models.configs import ModelConfig, RopeScaling
+
+# name templates: ours -> HF (dense). {i} = layer index.
+_DENSE_MAP = {
+    "attn_norm": "model.layers.{i}.input_layernorm.weight",
+    "mlp_norm": "model.layers.{i}.post_attention_layernorm.weight",
+    "wq": "model.layers.{i}.self_attn.q_proj.weight",
+    "wk": "model.layers.{i}.self_attn.k_proj.weight",
+    "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "wo": "model.layers.{i}.self_attn.o_proj.weight",
+    "bq": "model.layers.{i}.self_attn.q_proj.bias",
+    "bk": "model.layers.{i}.self_attn.k_proj.bias",
+    "bv": "model.layers.{i}.self_attn.v_proj.bias",
+    "w_gate": "model.layers.{i}.mlp.gate_proj.weight",
+    "w_up": "model.layers.{i}.mlp.up_proj.weight",
+    "w_down": "model.layers.{i}.mlp.down_proj.weight",
+}
+_MOE_MAP = {
+    "router": "model.layers.{i}.block_sparse_moe.gate.weight",
+    # experts get an extra {e} axis; HF w1=gate, w3=up, w2=down.
+    "w_gate": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+    "w_up": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+    "w_down": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+}
+# Linear weights stored [out, in] by torch; transpose to our [in, out].
+_TRANSPOSED = {
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router", "lm_head",
+}
+
+
+def _to_numpy(t) -> np.ndarray:
+    """torch tensor (possibly bf16) -> numpy, zero-copy where possible."""
+    import ml_dtypes
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+class _ShardedCheckpoint:
+    """Random access over one or more .safetensors files in a directory."""
+
+    def __init__(self, path: Path):
+        self.path = path
+        files = sorted(path.glob("*.safetensors"))
+        if not files:
+            raise FileNotFoundError(f"no .safetensors under {path}")
+        index_file = path / "model.safetensors.index.json"
+        self._name_to_file: dict[str, Path] = {}
+        if index_file.exists():
+            weight_map = json.loads(index_file.read_text())["weight_map"]
+            for name, fname in weight_map.items():
+                self._name_to_file[name] = path / fname
+        else:
+            from safetensors import safe_open
+
+            for f in files:
+                with safe_open(f, framework="pt") as sf:
+                    for name in sf.keys():
+                        self._name_to_file[name] = f
+        self._open: dict[Path, object] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._name_to_file
+
+    def names(self):
+        return self._name_to_file.keys()
+
+    def get(self, name: str) -> np.ndarray:
+        from safetensors import safe_open
+
+        f = self._name_to_file[name]
+        if f not in self._open:
+            self._open[f] = safe_open(f, framework="pt")
+        return _to_numpy(self._open[f].get_tensor(name))
+
+
+def _fetch(ckpt: _ShardedCheckpoint, name: str, ours: str, dtype):
+    arr = ckpt.get(name).astype(dtype)
+    if ours in _TRANSPOSED:
+        arr = arr.T
+    return arr
+
+
+def load_hf_params(
+    cfg: ModelConfig, path: str | Path, dtype=jnp.bfloat16
+) -> dict:
+    """Build an ``init_params``-shaped tree from an HF checkpoint dir.
+
+    ``cfg`` must structurally match the checkpoint (layer count, dims,
+    MoE-ness, qkv bias); mismatches raise with the offending tensor name.
+    """
+    path = Path(path)
+    ckpt = _ShardedCheckpoint(path)
+    np_dtype = jnp.dtype(dtype)
+
+    def stack_layers(ours: str, template: str) -> np.ndarray:
+        per_layer = []
+        for i in range(cfg.n_layers):
+            name = template.format(i=i)
+            if name not in ckpt:
+                raise KeyError(
+                    f"checkpoint missing {name!r} (for param {ours!r})"
+                )
+            per_layer.append(_fetch(ckpt, name, ours, np_dtype))
+        return np.stack(per_layer)
+
+    def stack_experts(ours: str, template: str) -> np.ndarray:
+        per_layer = []
+        for i in range(cfg.n_layers):
+            per_layer.append(
+                np.stack(
+                    [
+                        _fetch(
+                            ckpt, template.format(i=i, e=e), ours, np_dtype
+                        )
+                        for e in range(cfg.n_experts)
+                    ]
+                )
+            )
+        return np.stack(per_layer)
+
+    blocks: dict = {}
+    for ours in ("attn_norm", "mlp_norm", "wq", "wk", "wv", "wo"):
+        blocks[ours] = stack_layers(ours, _DENSE_MAP[ours])
+    if cfg.qkv_bias:
+        for ours in ("bq", "bk", "bv"):
+            blocks[ours] = stack_layers(ours, _DENSE_MAP[ours])
+    if cfg.is_moe:
+        blocks["router"] = stack_layers("router", _MOE_MAP["router"])
+        for ours in ("w_gate", "w_up", "w_down"):
+            blocks[ours] = stack_experts(ours, _MOE_MAP[ours])
+    else:
+        for ours in ("w_gate", "w_up", "w_down"):
+            blocks[ours] = stack_layers(ours, _DENSE_MAP[ours])
+
+    params: dict = {
+        "embed": ckpt.get("model.embed_tokens.weight").astype(np_dtype),
+        "blocks": blocks,
+        "norm_f": ckpt.get("model.norm.weight").astype(np_dtype),
+    }
+    if "lm_head.weight" in ckpt:
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "checkpoint has lm_head.weight but cfg.tie_embeddings=True"
+            )
+        params["lm_head"] = _fetch(
+            ckpt, "lm_head.weight", "lm_head", np_dtype
+        )
+    elif not cfg.tie_embeddings:
+        raise ValueError(
+            "checkpoint has no lm_head.weight; set cfg.tie_embeddings=True"
+        )
+
+    _validate_shapes(cfg, params)
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def _validate_shapes(cfg: ModelConfig, params: dict) -> None:
+    L, D = cfg.n_layers, cfg.d_model
+    Dh = cfg.head_dim
+    expect = {
+        ("blocks", "wq"): (L, D, cfg.n_heads * Dh),
+        ("blocks", "wk"): (L, D, cfg.n_kv_heads * Dh),
+        ("blocks", "wo"): (L, cfg.n_heads * Dh, D),
+        ("embed",): (cfg.vocab_size, D),
+    }
+    for keys, shape in expect.items():
+        node = params
+        for k in keys:
+            node = node[k]
+        if tuple(node.shape) != shape:
+            raise ValueError(
+                f"{'.'.join(keys)}: checkpoint shape {tuple(node.shape)} != "
+                f"config {shape} — wrong ModelConfig for this checkpoint?"
+            )
+
+
+def config_from_hf(path: str | Path, name: str = "hf") -> ModelConfig:
+    """Derive a ModelConfig from an HF ``config.json``.
+
+    Raises on config features we would otherwise silently mis-compute
+    (unknown rope_scaling types).
+    """
+    hf = json.loads((Path(path) / "config.json").read_text())
+    arch = (hf.get("architectures") or [""])[0]
+    is_moe = "Mixtral" in arch or "num_local_experts" in hf
+
+    rope_scaling = None
+    rs = hf.get("rope_scaling")
+    if rs:
+        rs_type = rs.get("rope_type") or rs.get("type")
+        if rs_type != "llama3":
+            raise ValueError(
+                f"unsupported rope_scaling type {rs_type!r} — only 'llama3' "
+                "(Llama-3.1) frequency rescaling is implemented"
+            )
+        rope_scaling = RopeScaling(
+            factor=float(rs["factor"]),
+            low_freq_factor=float(rs["low_freq_factor"]),
+            high_freq_factor=float(rs["high_freq_factor"]),
+            original_max_position_embeddings=int(
+                rs["original_max_position_embeddings"]
+            ),
+        )
+
+    # Mistral: sliding_window set => windowed attention. Qwen2 ships a
+    # sliding_window value but gates it off with use_sliding_window.
+    sliding_window = int(hf.get("sliding_window") or 0)
+    if "Qwen2" in arch and not hf.get("use_sliding_window", False):
+        sliding_window = 0
+
+    return ModelConfig(
+        name=name,
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        d_ff=hf.get("moe_intermediate_size") or hf["intermediate_size"],
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        max_seq_len=int(hf.get("max_position_embeddings", 8192)),
+        sliding_window=sliding_window,
+        qkv_bias="Qwen2" in arch,
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        n_experts=int(hf.get("num_local_experts", 0)) if is_moe else 0,
+        n_experts_per_token=int(hf.get("num_experts_per_tok", 2)),
+    )

@@ -38,6 +38,7 @@ from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.ops.activations import swiglu
 from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
 from llm_consensus_tpu.ops.norms import rms_norm
+from llm_consensus_tpu.ops.quant import maybe_dequantize as _w
 from llm_consensus_tpu.ops.rope import apply_rope, rope_cos_sin
 
 
@@ -51,12 +52,17 @@ def _rms(cfg: ModelConfig, x, w):
 
 def _attn_causal(cfg: ModelConfig, q, k, v, positions):
     # The fused kernel implements index-causal masking; packed/offset
-    # layouts (explicit positions) use the jnp path.
-    if cfg.use_pallas and positions is None and q.shape[1] % _pallas_blk(q.shape[1]) == 0:
+    # layouts (explicit positions) and sliding windows use the jnp path.
+    if (
+        cfg.use_pallas
+        and positions is None
+        and cfg.sliding_window == 0
+        and q.shape[1] % _pallas_blk(q.shape[1]) == 0
+    ):
         from llm_consensus_tpu.ops.pallas import flash_causal_attention
 
         return flash_causal_attention(q, k, v, blk_q=_pallas_blk(q.shape[1]))
-    return causal_attention(q, k, v, positions)
+    return causal_attention(q, k, v, positions, window=cfg.sliding_window)
 
 
 def _pallas_blk(s: int) -> int:
@@ -67,11 +73,13 @@ def _pallas_blk(s: int) -> int:
 
 
 def _attn_decode(cfg: ModelConfig, q, k_cache, v_cache, valid_len):
-    if cfg.use_pallas:
+    if cfg.use_pallas and cfg.sliding_window == 0:
         from llm_consensus_tpu.ops.pallas import flash_decode_attention
 
         return flash_decode_attention(q, k_cache, v_cache, valid_len)
-    return decode_attention(q, k_cache, v_cache, valid_len)
+    return decode_attention(
+        q, k_cache, v_cache, valid_len, window=cfg.sliding_window
+    )
 
 # ---------------------------------------------------------------------------
 # Init
@@ -141,9 +149,9 @@ def param_count(params) -> int:
 
 def _project_qkv(cfg: ModelConfig, p: dict, h: jnp.ndarray):
     b, s, _ = h.shape
-    q = h @ p["wq"]
-    k = h @ p["wk"]
-    v = h @ p["wv"]
+    q = h @ _w(p["wq"])
+    k = h @ _w(p["wk"])
+    v = h @ _w(p["wv"])
     if cfg.qkv_bias:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -156,7 +164,7 @@ def _project_qkv(cfg: ModelConfig, p: dict, h: jnp.ndarray):
 
 def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
     if not cfg.is_moe:
-        return swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
+        return swiglu(h, _w(p["w_gate"]), _w(p["w_up"]), _w(p["w_down"]))
     # Mixtral MoE: top-k routing, dense all-experts compute, weighted combine.
     router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, S, E]
     top_vals, top_idx = jax.lax.top_k(router_logits, cfg.n_experts_per_token)
@@ -167,9 +175,9 @@ def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
         * top_w[..., None],
         axis=-2,
     )
-    gate = jax.nn.silu(jnp.einsum("bsd,edf->bsef", h, p["w_gate"]))
-    up = jnp.einsum("bsd,edf->bsef", h, p["w_up"])
-    expert_out = jnp.einsum("bsef,efd->bsed", gate * up, p["w_down"])
+    gate = jax.nn.silu(jnp.einsum("bsd,edf->bsef", h, _w(p["w_gate"])))
+    up = jnp.einsum("bsd,edf->bsef", h, _w(p["w_up"]))
+    expert_out = jnp.einsum("bsef,efd->bsed", gate * up, _w(p["w_down"]))
     return jnp.einsum(
         "bsed,bse->bsd", expert_out, combine.astype(expert_out.dtype)
     )
@@ -215,7 +223,7 @@ def _block(
     else:  # pragma: no cover
         raise ValueError(mode)
 
-    x = x + attn.reshape(*x.shape[:-1], -1) @ p["wo"]
+    x = x + attn.reshape(*x.shape[:-1], -1) @ _w(p["wo"])
     h2 = _rms(cfg, x, p["mlp_norm"])
     x = x + _mlp(cfg, p, h2)
     return x, new_k, new_v
@@ -262,7 +270,7 @@ def _run_layers(
 
 def _unembed(cfg: ModelConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     x = _rms(cfg, x, params["norm_f"])
-    w = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    w = params["embed"].T if cfg.tie_embeddings else _w(params["lm_head"])
     return jnp.einsum("...d,dv->...v", x, w, preferred_element_type=jnp.float32)
 
 
@@ -286,7 +294,9 @@ def forward(
         )
     else:
         positions_arr = positions
-    cos, sin = rope_cos_sin(positions_arr, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(
+        positions_arr, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
     x, _ = _run_layers(
         cfg, params, x, cos, sin, None, "full", None, positions, remat=remat
     )
@@ -313,7 +323,9 @@ def prefill(
     """
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
     x, cache = _run_layers(
         cfg, params, x, cos, sin, cache, "prefill", None, None
     )
@@ -338,7 +350,9 @@ def decode_step(
     """
     x = params["embed"][tokens]  # [B, 1, D]
     positions = cache.length[:, None]  # [B, 1]
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
     x, cache = _run_layers(
         cfg, params, x, cos, sin, cache, "decode", cache.length, None
     )

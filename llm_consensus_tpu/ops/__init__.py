@@ -2,12 +2,22 @@ from llm_consensus_tpu.ops.norms import rms_norm
 from llm_consensus_tpu.ops.rope import apply_rope, rope_cos_sin
 from llm_consensus_tpu.ops.activations import swiglu
 from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
+from llm_consensus_tpu.ops.quant import (
+    QuantizedTensor,
+    dequantize,
+    quantize_params,
+    quantize_tensor,
+)
 
 __all__ = [
+    "QuantizedTensor",
     "rms_norm",
     "apply_rope",
     "rope_cos_sin",
     "swiglu",
     "causal_attention",
     "decode_attention",
+    "dequantize",
+    "quantize_params",
+    "quantize_tensor",
 ]

@@ -43,13 +43,15 @@ def causal_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     positions: jnp.ndarray | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Causal self-attention over a full (prefill) sequence.
 
     q: [B, S, H, D]; k/v: [B, S, Hkv, D] with H a multiple of Hkv (GQA).
     positions: optional [B, S] integer positions; when given, key j attends
     to query i iff pos_j <= pos_i (supports packed/offset layouts). Default
-    is index-causal.
+    is index-causal. ``window`` > 0 adds Mistral-style sliding-window
+    masking: query i also ignores keys with pos_i - pos_j >= window.
     """
     scale = q.shape[-1] ** -0.5
     scores = _gqa_scores(q, k) * scale  # [B, Hkv, G, Sq, Sk] fp32
@@ -58,11 +60,16 @@ def causal_attention(
         qi = jnp.arange(sq)[:, None]
         kj = jnp.arange(sk)[None, :]
         mask = kj <= qi  # [Sq, Sk]
+        if window > 0:
+            mask &= (qi - kj) < window
         mask = mask[None, None, None]
     else:
         qi = positions[:, :, None]  # [B, Sq, 1]
         kj = positions[:, None, :]  # [B, 1, Sk]
-        mask = (kj <= qi)[:, None, None]  # [B, 1, 1, Sq, Sk]
+        mask = kj <= qi
+        if window > 0:
+            mask &= (qi - kj) < window
+        mask = mask[:, None, None]  # [B, 1, 1, Sq, Sk]
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
@@ -74,18 +81,24 @@ def decode_attention(
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     valid_len: jnp.ndarray,
+    window: int = 0,
 ) -> jnp.ndarray:
     """One-token decode attention against a fixed-size KV cache.
 
     q: [B, 1, H, D]; k_cache/v_cache: [B, max_len, Hkv, D];
     valid_len: [B] number of valid cache slots per sequence (the new token's
     k/v must already be written; slots >= valid_len are masked out).
+    ``window`` > 0: only the last ``window`` cache slots attend (cache slot
+    index == token position; the query sits at position valid_len - 1).
     """
     scale = q.shape[-1] ** -0.5
     scores = _gqa_scores(q, k_cache) * scale  # [B, Hkv, G, 1, max_len]
     max_len = k_cache.shape[1]
     slot = jnp.arange(max_len)[None, :]  # [1, max_len]
-    mask = (slot < valid_len[:, None])[:, None, None, None]  # [B,1,1,1,max_len]
+    mask = slot < valid_len[:, None]
+    if window > 0:
+        mask &= slot >= (valid_len[:, None] - window)
+    mask = mask[:, None, None, None]  # [B,1,1,1,max_len]
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)

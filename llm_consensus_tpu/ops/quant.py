@@ -1,0 +1,109 @@
+"""Weight-only int8 quantization (per-output-channel, symmetric).
+
+Decode on TPU is HBM-bandwidth-bound: every generated token re-reads the
+full weight set, so halving weight bytes nearly halves the per-token
+latency floor. This module stores each large matmul weight as an int8
+tensor plus a per-output-channel fp32 scale; the dequantize (convert +
+multiply) happens on-chip and XLA fuses it into the consumer matmul's
+operand — HBM sees only int8 + scales. (The reference has no local
+compute at all to quantize — its model calls are remote HTTPS,
+``src/main.rs:82-86``; this is part of the TPU build's own perf work
+toward BASELINE.json's >=1k candidate-tokens/sec/chip floor.)
+
+Inference-only: quantized params are not differentiable (training keeps
+bf16 masters).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+# Weight leaves that get quantized, with the axis index of the
+# *contraction* (input) dimension in the stacked [L, ...] layout from
+# ``init_params`` (llm_consensus_tpu.models.transformer). Scales keep
+# that axis as size 1 (keepdims) so ranks — and therefore the sharding
+# rules in parallel/partitioning.py — are unchanged.
+_QUANT_AXES_DENSE = {
+    "wq": 1,
+    "wk": 1,
+    "wv": 1,
+    "wo": 1,
+    "w_gate": 1,
+    "w_up": 1,
+    "w_down": 1,
+}
+_QUANT_AXES_MOE = {"w_gate": 2, "w_up": 2, "w_down": 2}
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QuantizedTensor:
+    """int8 weight + fp32 per-output-channel scale (keepdims layout)."""
+
+    q: jnp.ndarray  # int8, same shape as the original weight
+    scale: jnp.ndarray  # float32, original shape with contraction dim = 1
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def quantize_tensor(w: jnp.ndarray, axis: int) -> QuantizedTensor:
+    """Symmetric per-channel int8: q = round(w / s), s = amax/127."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q, scale=scale)
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Materialize the bf16 weight on-chip (fused into the consumer)."""
+    return qt.q.astype(dtype) * qt.scale.astype(dtype)
+
+
+def maybe_dequantize(leaf, dtype=jnp.bfloat16):
+    """Pass-through for plain arrays; dequantize QuantizedTensor leaves."""
+    if isinstance(leaf, QuantizedTensor):
+        return dequantize(leaf, dtype)
+    return leaf
+
+
+def quantize_params(params: dict, *, quantize_lm_head: bool = True) -> dict:
+    """Quantize the large matmul weights of an ``init_params`` tree.
+
+    Norms, biases, the router (tiny), and the embedding gather table stay
+    in their original dtype. Works for dense and MoE block layouts (the
+    MoE leaves carry an extra leading expert axis).
+    """
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name, w in blocks.items():
+        axes = (
+            _QUANT_AXES_MOE
+            if (name in _QUANT_AXES_MOE and w.ndim == 4)
+            else _QUANT_AXES_DENSE
+        )
+        if name in axes and not isinstance(w, QuantizedTensor):
+            blocks[name] = quantize_tensor(w, axes[name])
+    out["blocks"] = blocks
+    if quantize_lm_head and "lm_head" in params and not isinstance(
+        params["lm_head"], QuantizedTensor
+    ):
+        out["lm_head"] = quantize_tensor(params["lm_head"], axis=0)
+    return out
+
+
+def quantized_bytes(params) -> int:
+    """Total parameter bytes as stored (int8 + scales count as-is)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+    )

@@ -9,21 +9,48 @@ precomputed table.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 
+def _llama3_rescale(inv_freq: jnp.ndarray, scaling) -> jnp.ndarray:
+    """Llama-3.1 'llama3' rope_scaling: long wavelengths divide by
+    ``factor``, short ones stay, a smooth ramp interpolates between
+    (matches transformers' _compute_llama3_parameters)."""
+    orig = scaling.original_max_position_embeddings
+    low_wavelen = orig / scaling.low_freq_factor
+    high_wavelen = orig / scaling.high_freq_factor
+    wavelen = 2.0 * math.pi / inv_freq
+    scaled = inv_freq / scaling.factor
+    smooth = (orig / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor
+    )
+    mid = (1 - smooth) * scaled + smooth * inv_freq
+    out = jnp.where(wavelen > low_wavelen, scaled, inv_freq)
+    return jnp.where(
+        (wavelen <= low_wavelen) & (wavelen >= high_wavelen), mid, out
+    )
+
+
 def rope_cos_sin(
-    positions: jnp.ndarray, head_dim: int, theta: float = 10000.0
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for given integer positions.
 
     positions: [...] int array (any shape, e.g. [B, S]).
     Returns cos, sin of shape [..., head_dim] (half-frequencies duplicated,
-    matching the rotate-half convention).
+    matching the rotate-half convention). ``scaling``: optional
+    :class:`llm_consensus_tpu.models.configs.RopeScaling`.
     """
     half = head_dim // 2
     freq_exponents = jnp.arange(half, dtype=jnp.float32) / half
     inv_freq = 1.0 / (theta**freq_exponents)  # [half]
+    if scaling is not None:
+        inv_freq = _llama3_rescale(inv_freq, scaling)
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., half]
     angles = jnp.concatenate([angles, angles], axis=-1)  # [..., head_dim]
     return jnp.cos(angles), jnp.sin(angles)

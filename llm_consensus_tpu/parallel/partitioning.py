@@ -66,15 +66,24 @@ def param_pspecs(params) -> dict:
     def rule(path, leaf):
         name = _leaf_name(path)
         if name in _MOE_RULES and leaf.ndim == len(_MOE_RULES[name]):
-            return _MOE_RULES[name]
-        if name in _DENSE_RULES:
+            spec = _MOE_RULES[name]
+        elif name in _DENSE_RULES:
             spec = _DENSE_RULES[name]
             if leaf.ndim != len(spec):
                 raise ValueError(
                     f"param {name!r} rank {leaf.ndim} != rule rank {len(spec)}"
                 )
-            return spec
-        raise ValueError(f"no sharding rule for param {name!r}")
+        else:
+            raise ValueError(f"no sharding rule for param {name!r}")
+        # Size-1 axes replicate: int8 scale tensors (ops/quant.py) keep
+        # the contraction dim as size 1 and would otherwise inherit a
+        # sharded spec on an unsplittable axis.
+        return P(
+            *(
+                None if leaf.shape[i] == 1 else spec[i]
+                for i in range(len(spec))
+            )
+        )
 
     return jax.tree_util.tree_map_with_path(rule, params)
 

@@ -97,7 +97,9 @@ def _pipeline_logits_local(
 
     x_mb = params["embed"][tokens_mb]  # [M, mb, S, D] — embed per stage
     positions = jnp.broadcast_to(jnp.arange(s), (mb, s))
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 

@@ -1,0 +1,188 @@
+"""HF safetensors loading (models/hf_loader.py): logit parity with
+transformers' own torch implementations on tiny random checkpoints.
+
+This is the strongest correctness anchor for the transformer: identical
+weights must give (near-)identical logits for every supported family —
+Llama (GQA), Qwen2 (qkv bias), Mixtral (MoE).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from llm_consensus_tpu.models.hf_loader import (  # noqa: E402
+    config_from_hf,
+    load_hf_params,
+)
+from llm_consensus_tpu.models.transformer import forward  # noqa: E402
+
+
+def _save_hf(tmp_path, model):
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return tmp_path
+
+
+def _parity(tmp_path, hf_model, batch=2, seq=12, tol=2e-2):
+    path = _save_hf(tmp_path, hf_model)
+    cfg = config_from_hf(path, name="tiny-hf")
+    params = load_hf_params(cfg, path, dtype=jnp.float32)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (batch, seq))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    ours = np.asarray(forward(cfg, params, jnp.asarray(tokens)))
+    # Compare softmax-space (logit offsets don't matter) and argmax.
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(
+        jax.nn.softmax(ours, axis=-1),
+        torch.softmax(torch.tensor(ref), dim=-1).numpy(),
+        atol=tol,
+    )
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.97
+
+
+def test_llama_parity(tmp_path):
+    config = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    _parity(tmp_path, transformers.LlamaForCausalLM(config))
+
+
+def test_qwen2_parity(tmp_path):
+    config = transformers.Qwen2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    _parity(tmp_path, transformers.Qwen2ForCausalLM(config))
+
+
+def test_mixtral_parity(tmp_path):
+    config = transformers.MixtralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    _parity(tmp_path, transformers.MixtralForCausalLM(config))
+
+
+def test_tied_embeddings(tmp_path):
+    config = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(3)
+    _parity(tmp_path, transformers.LlamaForCausalLM(config))
+
+
+def test_mistral_sliding_window_parity(tmp_path):
+    """Window smaller than the sequence so windowed masking is exercised."""
+    config = transformers.MistralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        sliding_window=4,
+        max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(5)
+    model = transformers.MistralForCausalLM(config)
+    path = _save_hf(tmp_path, model)
+    cfg = config_from_hf(path)
+    assert cfg.sliding_window == 4
+    _parity(tmp_path, model, seq=12)
+
+
+def test_llama31_rope_scaling_parity(tmp_path):
+    config = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 16,
+        },
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(6)
+    model = transformers.LlamaForCausalLM(config)
+    cfg = config_from_hf(_save_hf(tmp_path, model))
+    assert cfg.rope_scaling is not None and cfg.rope_scaling.factor == 8.0
+    _parity(tmp_path, model, seq=20)
+
+
+def test_unsupported_rope_scaling_raises(tmp_path):
+    config = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rope_scaling={"rope_type": "yarn", "factor": 2.0},
+    )
+    torch.manual_seed(7)
+    path = _save_hf(tmp_path, transformers.LlamaForCausalLM(config))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(path)
+
+
+def test_config_mismatch_raises(tmp_path):
+    config = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    torch.manual_seed(4)
+    path = _save_hf(tmp_path, transformers.LlamaForCausalLM(config))
+    cfg = config_from_hf(path).with_(n_layers=4)
+    with pytest.raises(KeyError):
+        load_hf_params(cfg, path)

@@ -1,0 +1,114 @@
+"""Weight-only int8 quantization (ops/quant.py).
+
+The reference has no local compute to quantize (its model is a remote
+API, ``src/main.rs:82-86``); quantization is part of this framework's
+own decode-throughput work (BASELINE.json north-star floor).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+from llm_consensus_tpu.engine.generate import generate
+from llm_consensus_tpu.models.configs import get_config
+from llm_consensus_tpu.models.transformer import forward, init_params
+from llm_consensus_tpu.ops.quant import (
+    QuantizedTensor,
+    dequantize,
+    quantize_params,
+    quantize_tensor,
+    quantized_bytes,
+)
+
+
+def test_quantize_roundtrip_error_bound():
+    """Per-channel symmetric int8: reconstruction error <= scale/2 + eps."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    qt = quantize_tensor(w, axis=0)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 128)
+    err = jnp.abs(dequantize(qt, jnp.float32) - w)
+    assert float(jnp.max(err - qt.scale / 2)) < 1e-6
+
+
+@pytest.mark.parametrize("preset", ["test-tiny", "test-tiny-moe"])
+def test_quantized_forward_close(preset):
+    """Quantized logits stay close to the full-precision logits."""
+    cfg = get_config(preset)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qp = quantize_params(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size
+    )
+    ref = forward(cfg, params, tokens)
+    out = forward(cfg, qp, tokens)
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.05
+
+
+def test_quantized_params_shrink_and_skip_small_leaves():
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qp = quantize_params(params)
+    assert quantized_bytes(qp) < 0.5 * quantized_bytes(params)
+    # Matmul weights quantized; norms/embed untouched; idempotent.
+    assert isinstance(qp["blocks"]["wq"], QuantizedTensor)
+    assert isinstance(qp["blocks"]["w_down"], QuantizedTensor)
+    assert not isinstance(qp["blocks"]["attn_norm"], QuantizedTensor)
+    assert not isinstance(qp["embed"], QuantizedTensor)
+    qp2 = quantize_params(qp)
+    assert qp2["blocks"]["wq"] is qp["blocks"]["wq"]
+
+
+def test_quantized_generate_runs():
+    """The jitted generate loop accepts a quantized param tree."""
+    cfg = get_config("test-tiny")
+    params = quantize_params(
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    )
+    tokens = jnp.ones((2, 8), jnp.int32)
+    out = generate(
+        cfg,
+        params,
+        tokens,
+        jnp.full((2,), 8, jnp.int32),
+        jax.random.PRNGKey(0),
+        jnp.zeros((2,), jnp.float32),
+        max_new_tokens=4,
+    )
+    assert out.tokens.shape == (2, 4)
+
+
+def test_quantized_params_shard_tensor_parallel(cpu_devices):
+    """int8 scales (size-1 contraction dim) must replicate, not inherit
+    the row-parallel spec — TP sharding of quantized params must work."""
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llm_consensus_tpu.parallel.partitioning import shard_params
+
+    cfg = get_config("test-tiny")
+    qp = quantize_params(
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    )
+    mesh = make_mesh(MeshConfig(data=2, model=4), cpu_devices)
+    sharded = shard_params(qp, mesh)
+    wo = sharded["blocks"]["wo"]
+    assert wo.q.sharding.spec == ("model",) or wo.q.sharding.spec[1] == "model"
+    assert "model" not in tuple(wo.scale.sharding.spec)
+    tokens = jnp.ones((2, 8), jnp.int32)
+    out = forward(cfg, sharded, tokens)
+    assert out.shape == (2, 8, cfg.vocab_size)
+
+
+def test_engine_quant_config():
+    """EngineConfig(quant='int8') quantizes at init; bad mode rejected."""
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = InferenceEngine(
+        cfg, params, engine_config=EngineConfig(quant="int8")
+    )
+    assert isinstance(eng.params["blocks"]["wq"], QuantizedTensor)
+    results = eng.generate_texts(["hello"], max_new_tokens=4)
+    assert len(results) == 1 and isinstance(results[0].text, str)
+    with pytest.raises(ValueError):
+        InferenceEngine(cfg, params, engine_config=EngineConfig(quant="fp4"))
