@@ -90,3 +90,22 @@ def sample_token(
     logprobs_full = jax.nn.log_softmax(scaled, axis=-1)
     logprob = logprobs_full[jnp.arange(b), tok]
     return tok, logprob
+
+
+def sample_token_per_row(
+    logits: jnp.ndarray,
+    keys: jax.Array,
+    temperature: jnp.ndarray,
+    config: SamplerConfig = SamplerConfig(),
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Like :func:`sample_token` but with an independent PRNG key per row
+    (continuous batching: each request owns its stream, so results don't
+    depend on which other requests share the batch)."""
+
+    def one(lg, k, t):
+        tok, lp = sample_token(lg[None], k, t[None], config)
+        return tok[0], lp[0]
+
+    return jax.vmap(one)(
+        logits, keys, jnp.asarray(temperature, jnp.float32)
+    )

@@ -15,9 +15,10 @@ layout:
 - bf16 tensors cross torch→numpy via a uint16 view (numpy itself has no
   bfloat16; ml_dtypes supplies the dtype on the jax side).
 
-Streaming: tensors are read shard-by-shard and released as soon as each
-stacked layer tensor is assembled, so peak host memory stays ~1 model
-copy at target dtype.
+Memory: tensors are read on demand through mmap'd shard handles (closed
+when loading finishes) and cast to the target dtype as each stacked
+tensor is assembled, so peak host memory stays ~1 model copy at target
+dtype plus the transiently-mapped shards.
 """
 
 from __future__ import annotations
@@ -106,6 +107,10 @@ class _ShardedCheckpoint:
             self._open[f] = safe_open(f, framework="pt")
         return _to_numpy(self._open[f].get_tensor(name))
 
+    def close(self) -> None:
+        """Release shard handles (and their mmaps)."""
+        self._open.clear()
+
 
 def _fetch(ckpt: _ShardedCheckpoint, name: str, ours: str, dtype):
     arr = ckpt.get(name).astype(dtype)
@@ -124,6 +129,13 @@ def load_hf_params(
     """
     path = Path(path)
     ckpt = _ShardedCheckpoint(path)
+    try:
+        return _load_hf_params(cfg, ckpt, dtype)
+    finally:
+        ckpt.close()
+
+
+def _load_hf_params(cfg: ModelConfig, ckpt: _ShardedCheckpoint, dtype) -> dict:
     np_dtype = jnp.dtype(dtype)
 
     def stack_layers(ours: str, template: str) -> np.ndarray:

@@ -1,0 +1,153 @@
+"""Paged KV cache: fixed page pool + per-sequence page tables.
+
+The dense :class:`llm_consensus_tpu.models.cache.KVCache` allocates
+``B x max_len`` up front — fine for uniform self-consistency fan-out,
+wasteful for a serving mix of short and long requests. The paged layout
+(vLLM-style, re-founded on XLA static shapes) keeps one global pool of
+fixed-size pages; each sequence owns an ordered list of page ids. All
+shapes are static: admission/eviction mutate *data* (page tables,
+lengths), never shapes, so the decode program compiles exactly once.
+
+The reference has no KV cache (no model code at all, SURVEY.md §0); this
+is infrastructure for the serving path the build adds (SURVEY.md §7
+step 5-6, BASELINE.json throughput targets).
+
+Layout:
+- pool k/v: ``[L, n_pages, page_size, Hkv, Dh]``
+- page_table: ``[max_seqs, pages_per_seq]`` int32 page ids (unused
+  entries can hold any valid id; masking is by ``length``).
+- length: ``[max_seqs]`` tokens written per sequence.
+
+Page 0 is reserved as the "null" page so freshly-reset tables are valid.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.models.configs import ModelConfig
+
+NULL_PAGE = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PagedKVCache:
+    k: jnp.ndarray  # [L, n_pages, page_size, Hkv, Dh]
+    v: jnp.ndarray
+    page_table: jnp.ndarray  # [max_seqs, pages_per_seq] int32
+    length: jnp.ndarray  # [max_seqs] int32
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig,
+        n_pages: int,
+        page_size: int,
+        max_seqs: int,
+        pages_per_seq: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        return PagedKVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            page_table=jnp.full((max_seqs, pages_per_seq), NULL_PAGE, jnp.int32),
+            length=jnp.zeros((max_seqs,), jnp.int32),
+        )
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_seqs(self) -> int:
+        return self.page_table.shape[0]
+
+    @property
+    def pages_per_seq(self) -> int:
+        return self.page_table.shape[1]
+
+
+def gather_seq_kv(
+    cache: PagedKVCache, seq_ids: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize contiguous [L, B, pages_per_seq*page, Hkv, Dh] K/V for
+    the given sequences (the jnp reference path; a Pallas kernel can read
+    through the table instead)."""
+    tables = cache.page_table[seq_ids]  # [B, P]
+    k = cache.k[:, tables]  # [L, B, P, page, Hkv, Dh]
+    v = cache.v[:, tables]
+    L, b, p, pg, h, d = k.shape
+    return k.reshape(L, b, p * pg, h, d), v.reshape(L, b, p * pg, h, d)
+
+
+def write_decode_kv(
+    cache: PagedKVCache,
+    seq_ids: jnp.ndarray,  # [B]
+    k_new: jnp.ndarray,  # [L, B, Hkv, Dh]
+    v_new: jnp.ndarray,
+) -> PagedKVCache:
+    """Write one token's K/V for each sequence at its current length."""
+    pos = cache.length[seq_ids]  # [B]
+    page_idx = pos // cache.page_size
+    offset = pos % cache.page_size
+    pages = cache.page_table[seq_ids, page_idx]  # [B]
+    k = cache.k.at[:, pages, offset].set(k_new.astype(cache.k.dtype))
+    v = cache.v.at[:, pages, offset].set(v_new.astype(cache.v.dtype))
+    length = cache.length.at[seq_ids].add(1)
+    return PagedKVCache(k=k, v=v, page_table=cache.page_table, length=length)
+
+
+def write_prefill_kv(
+    cache: PagedKVCache,
+    seq_id: jnp.ndarray,  # scalar int32
+    k_seq: jnp.ndarray,  # [L, S, Hkv, Dh] (S = padded prompt bucket)
+    v_seq: jnp.ndarray,
+    length: jnp.ndarray,  # scalar true prompt length
+) -> PagedKVCache:
+    """Scatter one prefilled sequence's K/V into its assigned pages.
+
+    S must be a multiple of page_size; slots past ``length`` hold padding
+    garbage, masked out of attention by ``length`` exactly as the dense
+    cache masks by ``valid_len``.
+    """
+    L, s, h, d = k_seq.shape
+    pg = cache.page_size
+    if s % pg:
+        raise ValueError(f"prefill length {s} not a multiple of page {pg}")
+    n = s // pg
+    pages = jax.lax.dynamic_slice_in_dim(
+        cache.page_table[seq_id], 0, n
+    )  # [n]
+    k_pages = k_seq.reshape(L, n, pg, h, d).astype(cache.k.dtype)
+    v_pages = v_seq.reshape(L, n, pg, h, d).astype(cache.v.dtype)
+    k = cache.k.at[:, pages].set(k_pages)
+    v = cache.v.at[:, pages].set(v_pages)
+    new_len = cache.length.at[seq_id].set(length.astype(jnp.int32))
+    return PagedKVCache(k=k, v=v, page_table=cache.page_table, length=new_len)
+
+
+def assign_pages(
+    cache: PagedKVCache, seq_id: jnp.ndarray, pages: jnp.ndarray
+) -> PagedKVCache:
+    """Install a page list (padded with NULL_PAGE) for one sequence."""
+    table = cache.page_table.at[seq_id].set(pages.astype(jnp.int32))
+    return PagedKVCache(
+        k=cache.k, v=cache.v, page_table=table, length=cache.length
+    )
+
+
+def release_seq(cache: PagedKVCache, seq_id: jnp.ndarray) -> PagedKVCache:
+    """Clear a sequence's table/length (page recycling is host-side)."""
+    table = cache.page_table.at[seq_id].set(NULL_PAGE)
+    length = cache.length.at[seq_id].set(0)
+    return PagedKVCache(
+        k=cache.k, v=cache.v, page_table=table, length=length
+    )

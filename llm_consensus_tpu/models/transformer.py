@@ -337,6 +337,61 @@ def prefill(
     return logits, cache.with_length(lengths)
 
 
+def decode_step_paged(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    cache,
+) -> tuple[jnp.ndarray, object]:
+    """One decode step for every cache sequence, paged layout.
+
+    tokens: [max_seqs, 1]. Each row b writes its new K/V at
+    ``page_table[b, length[b] // page]`` offset ``length[b] % page`` and
+    attends over its gathered pages. Inactive rows (empty tables) write
+    into the reserved NULL page — harmless garbage, outputs discarded by
+    the serving layer. Returns (logits [max_seqs, V] fp32, new cache).
+    """
+    from llm_consensus_tpu.models.paged_cache import PagedKVCache
+
+    b = tokens.shape[0]
+    pos = cache.length  # [B] current write position
+    x = params["embed"][tokens]  # [B, 1, D]
+    cos, sin = rope_cos_sin(
+        pos[:, None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+    pg = cache.page_size
+    pages_now = cache.page_table[jnp.arange(b), pos // pg]  # [B]
+    offset = pos % pg
+    tables = cache.page_table  # [B, P]
+
+    def body(carry, layer_in):
+        p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
+        h = _rms(cfg, carry, p["attn_norm"])
+        q, k, v = _project_qkv(cfg, p, h)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pool = k_pool.at[pages_now, offset].set(k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[pages_now, offset].set(v[:, 0].astype(v_pool.dtype))
+        k_seq = k_pool[tables].reshape(b, -1, cfg.n_kv_heads, cfg.head_dim)
+        v_seq = v_pool[tables].reshape(b, -1, cfg.n_kv_heads, cfg.head_dim)
+        attn = decode_attention(
+            q, k_seq, v_seq, pos + 1, window=cfg.sliding_window
+        )
+        y = carry + attn.reshape(*carry.shape[:-1], -1) @ _w(p["wo"])
+        h2 = _rms(cfg, y, p["mlp_norm"])
+        y = y + _mlp(cfg, p, h2)
+        return y, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    logits = _unembed(cfg, params, x[:, 0])
+    new_cache = PagedKVCache(
+        k=new_k, v=new_v, page_table=cache.page_table, length=pos + 1
+    )
+    return logits, new_cache
+
+
 def decode_step(
     cfg: ModelConfig,
     params: dict,

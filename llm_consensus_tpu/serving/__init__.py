@@ -3,15 +3,29 @@
 The reference serves exactly one question at a time from its REPL
 (``src/main.rs:428-471``) and fans out each panel step as independent
 HTTP futures. Here concurrent producers (REPL sessions, eval harness,
-panel fan-outs) enqueue requests; a scheduler thread drains the queue
-into shape-bucketed batches and runs ONE device program per batch —
-device-batching replaces request concurrency (SURVEY.md §7).
+panel fan-outs) enqueue requests; two granularities are offered:
+
+- :class:`BatchScheduler` — request-level batching (a batch runs to
+  completion); simplest, best for uniform fan-outs.
+- :class:`ContinuousBatcher` — token-level continuous batching over a
+  paged KV cache; requests join and leave the running decode batch at
+  step granularity (the throughput-serving mode).
 """
 
+from llm_consensus_tpu.serving.continuous import (
+    ContinuousBatcher,
+    ContinuousConfig,
+)
 from llm_consensus_tpu.serving.scheduler import (
     BatchScheduler,
     SchedulerConfig,
     ServingBackend,
 )
 
-__all__ = ["BatchScheduler", "SchedulerConfig", "ServingBackend"]
+__all__ = [
+    "BatchScheduler",
+    "ContinuousBatcher",
+    "ContinuousConfig",
+    "SchedulerConfig",
+    "ServingBackend",
+]

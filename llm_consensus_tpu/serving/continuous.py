@@ -1,0 +1,333 @@
+"""Continuous batching: token-level request interleaving on one chip.
+
+The :class:`llm_consensus_tpu.serving.scheduler.BatchScheduler` batches
+whole requests (a batch runs to completion before the next starts); this
+module admits and retires requests at *decode-step* granularity, vLLM
+style, re-founded on XLA's compile-once constraint:
+
+- One jitted, donated decode-step program over a fixed ``max_slots``-wide
+  paged cache (:mod:`llm_consensus_tpu.models.paged_cache`): shapes never
+  change, so the hot loop never recompiles. Admission/retirement mutate
+  page tables and lengths — data, not shapes.
+- Prefill runs per-admission on bucketed shapes (compiles once per
+  bucket) and scatters K/V into the sequence's pages.
+- A host thread drives: admit waiting requests into free slots, run one
+  decode step for all slots, sample, retire EOS/length-capped slots,
+  resolve futures. Inactive slots decode into the reserved NULL page and
+  their outputs are discarded (the cost of a dead slot is one row of an
+  already-batched matmul — negligible next to recompilation or bubbles).
+
+Pages for the whole request (prompt + max_new_tokens) are reserved at
+admission; requests wait while the pool is exhausted (no mid-flight
+growth/preemption in v1 — simpler, and cannot deadlock).
+
+The reference processes requests strictly one-question-at-a-time with
+unbounded per-call HTTP concurrency (``src/main.rs:101,156,182``); this
+is the TPU-native throughput-serving counterpart.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_consensus_tpu.engine.engine import _next_bucket
+from llm_consensus_tpu.engine.sampler import (
+    SamplerConfig,
+    sample_token,
+    sample_token_per_row,
+)
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
+from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.configs import ModelConfig
+from llm_consensus_tpu.models.paged_cache import (
+    NULL_PAGE,
+    PagedKVCache,
+    assign_pages,
+    release_seq,
+    write_prefill_kv,
+)
+from llm_consensus_tpu.models.transformer import decode_step_paged, prefill
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ContinuousConfig:
+    max_slots: int = 8
+    page_size: int = 64
+    n_pages: int = 512  # pool size (excl. semantics: page 0 is reserved)
+    pages_per_seq: int = 32  # table width = max seq len / page_size
+    max_new_tokens: int = 256
+    seq_buckets: tuple[int, ...] = (64, 128, 256, 512)
+    sampler: SamplerConfig | None = None
+    poll_interval_s: float = 0.001
+
+
+@dataclass
+class _Request:
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    temperature: float
+    seed: int
+    future: Future
+
+
+@dataclass
+class _Slot:
+    request: _Request
+    pages: list[int]
+    generated: list[int]
+    prompt_len: int
+
+
+class ContinuousBatcher:
+    """Token-level continuous batching over one model's weights."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        tokenizer: Tokenizer | None = None,
+        config: ContinuousConfig | None = None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.config = config or ContinuousConfig()
+        c = self.config
+        self.cache = PagedKVCache.create(
+            cfg, c.n_pages, c.page_size, c.max_slots, c.pages_per_seq
+        )
+        # Host-side page allocator; page 0 is the NULL page.
+        self._free_pages = deque(range(1, c.n_pages))
+        self._slots: list[_Slot | None] = [None] * c.max_slots
+        self._waiting: deque[_Request] = deque()
+        self._last_tokens = np.zeros((c.max_slots,), np.int32)
+        # Per-slot PRNG state: requests own their stream (seed, token
+        # index), so sampling is reproducible regardless of batch-mates.
+        self._seeds = np.zeros((c.max_slots,), np.int32)
+        self._counts = np.zeros((c.max_slots,), np.int32)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._work = threading.Event()
+        # params ride as a jit argument (not a closure constant) so the
+        # weights aren't baked into the executable.
+        self._jit_decode = jax.jit(self._decode_sample, donate_argnums=(1,))
+        self._jit_prefill = {}
+        self._thread = threading.Thread(
+            target=self._run, name="continuous-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- device programs ------------------------------------------------
+
+    def _decode_sample(self, params, cache, tokens, seeds, counts, temps):
+        logits, cache = decode_step_paged(
+            self.cfg, params, tokens[:, None], cache
+        )
+        sampler = self.config.sampler or SamplerConfig()
+        keys = jax.vmap(
+            lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+        )(seeds, counts)
+        next_tok, logp = sample_token_per_row(logits, keys, temps, sampler)
+        return next_tok, logp, cache
+
+    def _prefill_fn(self, s_bucket: int):
+        """Jitted per-bucket: prefill one prompt densely, scatter to pages."""
+        if s_bucket not in self._jit_prefill:
+
+            def f(params, cache, tokens, length, seq_id):
+                dense = KVCache.create(self.cfg, 1, s_bucket)
+                logits, dense = prefill(
+                    self.cfg, params, tokens, length[None], dense
+                )
+                cache = write_prefill_kv(
+                    cache, seq_id, dense.k[:, 0], dense.v[:, 0], length
+                )
+                return logits[0], cache
+
+            self._jit_prefill[s_bucket] = jax.jit(f, donate_argnums=(1,))
+        return self._jit_prefill[s_bucket]
+
+    # -- public API -----------------------------------------------------
+
+    def submit(
+        self,
+        prompt: str,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> Future:
+        """Enqueue a request; Future resolves to the generated text."""
+        if self._stop.is_set():
+            raise RuntimeError("batcher stopped")
+        c = self.config
+        if max_new_tokens is None:
+            max_new_tokens = c.max_new_tokens
+        if max_new_tokens <= 0:
+            raise ValueError(f"max_new_tokens must be > 0, got {max_new_tokens}")
+        ids = np.asarray(
+            self.tokenizer.encode(prompt)[- (c.seq_buckets[-1]) :], np.int32
+        )
+        req = _Request(
+            prompt_ids=ids,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            seed=seed,
+            future=Future(),
+        )
+        with self._lock:
+            self._waiting.append(req)
+        self._work.set()
+        return req.future
+
+    def close(self) -> None:
+        self._stop.set()
+        self._work.set()
+        self._thread.join(timeout=10)
+        with self._lock:
+            for req in self._waiting:
+                if not req.future.done():
+                    req.future.set_exception(RuntimeError("batcher stopped"))
+            for slot in self._slots:
+                if slot and not slot.request.future.done():
+                    slot.request.future.set_exception(
+                        RuntimeError("batcher stopped")
+                    )
+
+    # -- host loop ------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        return _next_bucket(n, self.config.seq_buckets)
+
+    def _pages_needed(self, req: _Request) -> int:
+        total = self._bucket(len(req.prompt_ids)) + req.max_new_tokens
+        pg = self.config.page_size
+        return -(-total // pg)
+
+    def _admit(self) -> None:
+        c = self.config
+        while self._waiting:
+            free_slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if free_slot is None:
+                return
+            with self._lock:
+                if not self._waiting:
+                    return
+                req = self._waiting[0]
+                n_pages = self._pages_needed(req)
+                # n_pages - 1: page 0 is the reserved NULL page.
+                fits_ever = min(c.pages_per_seq, c.n_pages - 1)
+                if n_pages > fits_ever:
+                    self._waiting.popleft()
+                    req.future.set_exception(
+                        ValueError(
+                            f"request needs {n_pages} pages but the "
+                            f"configuration caps a sequence at {fits_ever} "
+                            f"(pages_per_seq={c.pages_per_seq}, usable "
+                            f"pool={c.n_pages - 1})"
+                        )
+                    )
+                    continue
+                if len(self._free_pages) < n_pages:
+                    return  # pool exhausted; retry after retirements
+                self._waiting.popleft()
+                pages = [self._free_pages.popleft() for _ in range(n_pages)]
+
+            s_bucket = self._bucket(len(req.prompt_ids))
+            padded = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+            padded[0, : len(req.prompt_ids)] = req.prompt_ids
+            table = np.full((c.pages_per_seq,), NULL_PAGE, np.int32)
+            table[: len(pages)] = pages
+            self.cache = assign_pages(
+                self.cache, jnp.int32(free_slot), jnp.asarray(table)
+            )
+            logits, self.cache = self._prefill_fn(s_bucket)(
+                self.params,
+                self.cache,
+                jnp.asarray(padded),
+                jnp.int32(len(req.prompt_ids)),
+                jnp.int32(free_slot),
+            )
+            # First sampled token comes from the prefill logits.
+            key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
+            tok, _ = sample_token(
+                logits[None],
+                key,
+                jnp.asarray([req.temperature], jnp.float32),
+                self.config.sampler or SamplerConfig(),
+            )
+            first = int(tok[0])
+            slot = _Slot(
+                request=req,
+                pages=pages,
+                generated=[first],
+                prompt_len=len(req.prompt_ids),
+            )
+            self._slots[free_slot] = slot
+            self._last_tokens[free_slot] = first
+            self._seeds[free_slot] = req.seed
+            self._counts[free_slot] = 1  # token 0 sampled from prefill
+            if first == self.tokenizer.eos_id or req.max_new_tokens <= 1:
+                self._retire(free_slot)
+
+    def _retire(self, idx: int) -> None:
+        slot = self._slots[idx]
+        assert slot is not None
+        self.cache = release_seq(self.cache, jnp.int32(idx))
+        self._free_pages.extend(slot.pages)
+        self._slots[idx] = None
+        ids = [
+            t for t in slot.generated if t != self.tokenizer.eos_id
+        ]
+        if not slot.request.future.done():
+            slot.request.future.set_result(self.tokenizer.decode(ids))
+
+    def _step(self) -> None:
+        c = self.config
+        temps = np.zeros((c.max_slots,), np.float32)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                temps[i] = slot.request.temperature
+        next_tok, _, self.cache = self._jit_decode(
+            self.params,
+            self.cache,
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._seeds),
+            jnp.asarray(self._counts),
+            jnp.asarray(temps),
+        )
+        next_np = np.asarray(next_tok)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tok = int(next_np[i])
+            slot.generated.append(tok)
+            self._last_tokens[i] = tok
+            self._counts[i] += 1
+            done = (
+                tok == self.tokenizer.eos_id
+                or len(slot.generated) >= slot.request.max_new_tokens
+            )
+            if done:
+                self._retire(i)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._admit()
+            if any(s is not None for s in self._slots):
+                self._step()
+            else:
+                self._work.wait(timeout=0.1)
+                self._work.clear()

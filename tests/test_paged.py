@@ -1,0 +1,234 @@
+"""Paged KV cache + continuous batching (models/paged_cache.py,
+serving/continuous.py).
+
+Parity anchor: paged decode must produce exactly the greedy tokens of
+the dense-cache generate loop — same model, same prompts.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.configs import get_config
+from llm_consensus_tpu.models.paged_cache import (
+    NULL_PAGE,
+    PagedKVCache,
+    assign_pages,
+    gather_seq_kv,
+    release_seq,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from llm_consensus_tpu.models.transformer import (
+    decode_step,
+    decode_step_paged,
+    init_params,
+    prefill,
+)
+from llm_consensus_tpu.serving.continuous import (
+    ContinuousBatcher,
+    ContinuousConfig,
+)
+
+CFG = get_config("test-tiny")
+
+
+def _params():
+    return init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def test_page_write_gather_roundtrip():
+    cache = PagedKVCache.create(
+        CFG, n_pages=8, page_size=4, max_seqs=2, pages_per_seq=3,
+        dtype=jnp.float32,
+    )
+    cache = assign_pages(cache, jnp.int32(0), jnp.asarray([2, 5, 7]))
+    L, h, d = CFG.n_layers, CFG.n_kv_heads, CFG.head_dim
+    k_seq = jnp.arange(L * 8 * h * d, dtype=jnp.float32).reshape(L, 8, h, d)
+    cache = write_prefill_kv(cache, jnp.int32(0), k_seq, k_seq, jnp.int32(7))
+    k_g, v_g = gather_seq_kv(cache, jnp.asarray([0]))
+    assert k_g.shape == (L, 1, 12, h, d)
+    np.testing.assert_array_equal(np.asarray(k_g[:, 0, :8]), np.asarray(k_seq))
+    # Decode write lands at position 7 = page 1 (id 5), offset 3.
+    k_new = jnp.ones((L, 1, h, d), jnp.float32) * 99.0
+    cache = write_decode_kv(cache, jnp.asarray([0]), k_new, k_new)
+    assert int(cache.length[0]) == 8
+    np.testing.assert_array_equal(
+        np.asarray(cache.k[:, 5, 3]), np.asarray(k_new[:, 0])
+    )
+    cache = release_seq(cache, jnp.int32(0))
+    assert int(cache.length[0]) == 0
+    assert int(cache.page_table[0, 0]) == NULL_PAGE
+
+
+def test_paged_decode_matches_dense():
+    """Greedy decode over the paged cache == dense-cache decode_step."""
+    params = _params()
+    prompt = jnp.asarray(
+        [[5, 6, 7, 8, 9, 10, 11, 12]], jnp.int32
+    )  # [1, 8]
+    steps = 6
+
+    dense = KVCache.create(CFG, 1, 32, dtype=jnp.float32)
+    logits, dense = prefill(CFG, params, prompt, jnp.asarray([8]), dense)
+    dense_toks = []
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    for _ in range(steps):
+        dense_toks.append(int(tok[0]))
+        logits, dense = decode_step(CFG, params, tok[:, None], dense)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+
+    paged = PagedKVCache.create(
+        CFG, n_pages=16, page_size=4, max_seqs=2, pages_per_seq=8,
+        dtype=jnp.float32,
+    )
+    paged = assign_pages(
+        paged, jnp.int32(1), jnp.asarray([3, 9, 4, 11, 0, 0, 0, 0])
+    )
+    d2 = KVCache.create(CFG, 1, 8, dtype=jnp.float32)
+    logits2, d2 = prefill(CFG, params, prompt, jnp.asarray([8]), d2)
+    paged = write_prefill_kv(
+        paged, jnp.int32(1), d2.k[:, 0], d2.v[:, 0], jnp.int32(8)
+    )
+    tok2 = jnp.argmax(logits2, -1).astype(jnp.int32)
+    paged_toks = []
+    for _ in range(steps):
+        paged_toks.append(int(tok2[0]))
+        full = jnp.zeros((2,), jnp.int32).at[1].set(tok2[0])
+        logits2, paged = decode_step_paged(CFG, params, full[:, None], paged)
+        tok2 = jnp.argmax(logits2[1:2], -1).astype(jnp.int32)
+
+    assert paged_toks == dense_toks
+
+
+@pytest.fixture
+def batcher():
+    b = ContinuousBatcher(
+        CFG,
+        _params(),
+        config=ContinuousConfig(
+            max_slots=4,
+            page_size=16,
+            n_pages=64,
+            pages_per_seq=8,
+            max_new_tokens=8,
+            seq_buckets=(16, 32, 64),
+        ),
+    )
+    yield b
+    b.close()
+
+
+def test_continuous_matches_engine_greedy(batcher):
+    """Staggered continuous-batch requests == one-shot engine results."""
+    prompts = ["hello world", "the quick brown fox", "abc"]
+    futures = []
+    for p in prompts:
+        futures.append(batcher.submit(p, max_new_tokens=8))
+        time.sleep(0.02)  # arrive mid-flight
+    got = [f.result(timeout=120) for f in futures]
+
+    eng = InferenceEngine(
+        CFG,
+        _params(),
+        engine_config=EngineConfig(
+            max_new_tokens=8, seq_buckets=(16, 32, 64)
+        ),
+    )
+    want = [
+        r.text for r in eng.generate_texts(prompts, max_new_tokens=8)
+    ]
+    assert got == want
+
+
+def test_continuous_pool_exhaustion_recovers():
+    """More requests than pool pages: later ones wait, all complete."""
+    b = ContinuousBatcher(
+        CFG,
+        _params(),
+        config=ContinuousConfig(
+            max_slots=2,
+            page_size=16,
+            n_pages=5,  # 4 usable pages; each request needs 2
+            pages_per_seq=4,
+            max_new_tokens=4,
+            seq_buckets=(16,),
+        ),
+    )
+    try:
+        futures = [b.submit(f"q{i}", max_new_tokens=4) for i in range(5)]
+        texts = [f.result(timeout=120) for f in futures]
+        assert len(texts) == 5
+        assert all(isinstance(t, str) for t in texts)
+    finally:
+        b.close()
+
+
+def test_seed_reproducible_across_batch_states(batcher):
+    """Same (prompt, seed, temperature) gives the same text whether it
+    runs alone or alongside other requests."""
+    alone = batcher.submit("xyz", temperature=1.0, seed=7).result(timeout=120)
+    futs = [
+        batcher.submit(p, temperature=1.0, seed=7 + i)
+        for i, p in enumerate(["aaa", "xyz", "bbb"], start=0)
+    ]
+    # The "xyz" row used seed 8 here; resubmit with seed 7 amid traffic.
+    crowd = batcher.submit("xyz", temperature=1.0, seed=7)
+    [f.result(timeout=120) for f in futs]
+    assert crowd.result(timeout=120) == alone
+    # Different seeds should (overwhelmingly) differ on a tiny random
+    # model with temperature 1.
+    other = batcher.submit("xyz", temperature=1.0, seed=8).result(timeout=120)
+    assert other != alone
+
+
+def test_impossible_pool_request_fails_fast():
+    """pages_per_seq would allow it but the pool can never satisfy it."""
+    b = ContinuousBatcher(
+        CFG,
+        _params(),
+        config=ContinuousConfig(
+            max_slots=2,
+            page_size=16,
+            n_pages=4,  # 3 usable
+            pages_per_seq=8,
+            max_new_tokens=64,
+            seq_buckets=(16,),
+        ),
+    )
+    try:
+        with pytest.raises(ValueError, match="pool"):
+            b.submit("hi", max_new_tokens=64).result(timeout=60)
+    finally:
+        b.close()
+
+
+def test_zero_max_new_tokens_rejected(batcher):
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        batcher.submit("hi", max_new_tokens=0)
+
+
+def test_oversized_request_rejected():
+    b = ContinuousBatcher(
+        CFG,
+        _params(),
+        config=ContinuousConfig(
+            max_slots=2,
+            page_size=16,
+            n_pages=32,
+            pages_per_seq=2,  # max 32 tokens total
+            max_new_tokens=64,
+            seq_buckets=(16,),
+        ),
+    )
+    try:
+        with pytest.raises(ValueError, match="pages"):
+            b.submit("hi", max_new_tokens=64).result(timeout=60)
+    finally:
+        b.close()
