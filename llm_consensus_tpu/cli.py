@@ -56,12 +56,21 @@ def _build_backend(args):
     from llm_consensus_tpu.models.configs import get_config
     from llm_consensus_tpu.models.transformer import init_params
 
-    cfg = get_config(args.model)
-    if args.checkpoint:
+    if args.hf_checkpoint:
+        from llm_consensus_tpu.models.hf_loader import (
+            config_from_hf,
+            load_hf_params,
+        )
+
+        cfg = config_from_hf(args.hf_checkpoint, name=args.model)
+        params = load_hf_params(cfg, args.hf_checkpoint)
+    elif args.checkpoint:
         from llm_consensus_tpu.checkpoint.io import load_params
 
+        cfg = get_config(args.model)
         params = load_params(args.checkpoint)
     else:
+        cfg = get_config(args.model)
         log.warning(
             "No --checkpoint given: using RANDOM weights for %s "
             "(protocol/e2e plumbing only; text will be gibberish).",
@@ -72,7 +81,9 @@ def _build_backend(args):
         cfg,
         params,
         tokenizer=load_tokenizer(args.tokenizer),
-        engine_config=EngineConfig(max_new_tokens=args.max_new_tokens),
+        engine_config=EngineConfig(
+            max_new_tokens=args.max_new_tokens, quant=args.quant
+        ),
     )
     return LocalBackend(engine)
 
@@ -85,6 +96,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["fake", "local"], default="fake")
     p.add_argument("--model", default="llama-1b", help="model preset name")
     p.add_argument("--checkpoint", default=None, help="orbax checkpoint dir")
+    p.add_argument(
+        "--hf-checkpoint",
+        default=None,
+        help="HF safetensors checkpoint dir (config.json derives the "
+        "model config; overrides --model/--checkpoint)",
+    )
+    p.add_argument(
+        "--quant",
+        choices=["none", "int8"],
+        default="none",
+        help="weight-only quantization for the local engine",
+    )
     p.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
     p.add_argument("--panel", default=None, help="panel JSON file")
     p.add_argument(

@@ -45,6 +45,10 @@ class ModelConfig:
     # MoE (Mixtral): 0 experts = dense MLP.
     n_experts: int = 0
     n_experts_per_token: int = 2
+    # > 0 enables capacity-bounded GShard-style dispatch (compute only
+    # routed tokens, capacity = ceil(T*k/E * factor)); 0 = dense
+    # all-experts compute (exact, E/k x the FLOPs).
+    moe_capacity_factor: float = 0.0
     # Use the fused Pallas kernels (ops/pallas) for attention + RMSNorm on
     # the hot path; False = pure-XLA jnp reference ops.
     use_pallas: bool = False

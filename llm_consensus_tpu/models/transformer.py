@@ -165,6 +165,8 @@ def _project_qkv(cfg: ModelConfig, p: dict, h: jnp.ndarray):
 def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
     if not cfg.is_moe:
         return swiglu(h, _w(p["w_gate"]), _w(p["w_up"]), _w(p["w_down"]))
+    if cfg.moe_capacity_factor > 0:
+        return _moe_dispatch(cfg, p, h)
     # Mixtral MoE: top-k routing, dense all-experts compute, weighted combine.
     router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, S, E]
     top_vals, top_idx = jax.lax.top_k(router_logits, cfg.n_experts_per_token)
@@ -181,6 +183,53 @@ def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum(
         "bsed,bse->bsd", expert_out, combine.astype(expert_out.dtype)
     )
+
+
+def _moe_dispatch(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
+    """GShard/Switch-style capacity-bounded expert dispatch.
+
+    The dense path above computes EVERY expert for every token (E/k times
+    the needed FLOPs — 4x for Mixtral's 8-choose-2); this packs each
+    expert's assigned tokens into a fixed-capacity [E, C, D] buffer via
+    einsum dispatch masks, so only routed tokens are computed and the
+    expert axis shards cleanly over ``expert`` (the dispatch einsums
+    become GSPMD all-to-alls). Static capacity
+    C = ceil(T * k / E * capacity_factor); tokens past an expert's
+    capacity fall back to that expert contributing nothing (standard
+    GShard semantics — first-come within (choice-rank, token) order).
+    """
+    b, s, d = h.shape
+    t = b * s
+    e, k = cfg.n_experts, cfg.n_experts_per_token
+    cap = -(-t * k * cfg.moe_capacity_factor // e)
+    cap = int(min(max(cap, 1), t * k))
+    x = h.reshape(t, d)
+
+    router_logits = (x @ p["router"]).astype(jnp.float32)  # [T, E]
+    top_vals, top_idx = jax.lax.top_k(router_logits, k)
+    top_w = jax.nn.softmax(top_vals, axis=-1)  # [T, k]
+
+    # Queue position of each (choice-rank, token) in its expert's buffer:
+    # rank-major order gives first choices priority when capacity binds.
+    onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [T, k, E]
+    onehot_kt = onehot.transpose(1, 0, 2).reshape(k * t, e)
+    pos = jnp.cumsum(onehot_kt, axis=0) - onehot_kt  # [k*T, E]
+    keep = (pos < cap) * onehot_kt
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+    dispatch_kt = keep[..., None] * slot  # [k*T, E, C]
+    dispatch = dispatch_kt.reshape(k, t, e, cap)
+
+    # combine[t, e, c] = router weight of token t at its slot.
+    combine = jnp.einsum("ktec,tk->tec", dispatch, top_w)
+    disp_mask = dispatch.sum(0)  # [T, E, C] 0/1
+
+    xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp_mask)
+    xin = xin.astype(h.dtype)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, _w(p["w_gate"])))
+    up = jnp.einsum("ecd,edf->ecf", xin, _w(p["w_up"]))
+    out_e = jnp.einsum("ecf,efd->ecd", gate * up, _w(p["w_down"]))
+    y = jnp.einsum("ecd,tec->td", out_e.astype(jnp.float32), combine)
+    return y.astype(h.dtype).reshape(b, s, d)
 
 
 def _block(

@@ -21,6 +21,11 @@ from llm_consensus_tpu.parallel.partitioning import (
     param_pspecs,
     shard_params,
 )
+from llm_consensus_tpu.parallel.multihost import (
+    DistributedConfig,
+    initialize_distributed,
+    make_multislice_mesh,
+)
 from llm_consensus_tpu.parallel.pipeline import (
     make_pipeline_forward,
     make_pipeline_train_step,
@@ -30,8 +35,11 @@ from llm_consensus_tpu.parallel.pipeline import (
 from llm_consensus_tpu.parallel.ring import ring_attention
 
 __all__ = [
+    "DistributedConfig",
     "MeshConfig",
     "best_mesh_for",
+    "initialize_distributed",
+    "make_multislice_mesh",
     "batch_pspec",
     "cache_pspecs",
     "make_mesh",

@@ -32,6 +32,42 @@ def test_panel_file_roundtrip(tmp_path, capsys):
     assert rc == 0
 
 
+def test_hf_checkpoint_backend(tmp_path, capsys):
+    """--backend local --hf-checkpoint loads real HF weights end-to-end."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    config = transformers.LlamaConfig(
+        vocab_size=384,  # >= ByteTokenizer's 259 ids
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    transformers.LlamaForCausalLM(config).save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+    rc = main(
+        [
+            "--backend",
+            "local",
+            "--hf-checkpoint",
+            str(tmp_path),
+            "--quant",
+            "int8",
+            "--question",
+            "hi",
+            "--max-new-tokens",
+            "4",
+            "--seed",
+            "0",
+        ]
+    )
+    assert rc == 0
+
+
 def test_eval_requires_local_backend(capsys):
     rc = main(["--backend", "fake", "--eval-gsm8k", "synthetic"])
     assert rc == 2
