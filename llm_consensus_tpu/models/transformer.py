@@ -38,6 +38,7 @@ from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.ops.activations import swiglu
 from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
 from llm_consensus_tpu.ops.norms import rms_norm
+from llm_consensus_tpu.ops.quant import matmul as _qmm
 from llm_consensus_tpu.ops.quant import maybe_dequantize as _w
 from llm_consensus_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -149,9 +150,9 @@ def param_count(params) -> int:
 
 def _project_qkv(cfg: ModelConfig, p: dict, h: jnp.ndarray):
     b, s, _ = h.shape
-    q = h @ _w(p["wq"])
-    k = h @ _w(p["wk"])
-    v = h @ _w(p["wv"])
+    q = _qmm(h, p["wq"])
+    k = _qmm(h, p["wk"])
+    v = _qmm(h, p["wv"])
     if cfg.qkv_bias:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -164,7 +165,7 @@ def _project_qkv(cfg: ModelConfig, p: dict, h: jnp.ndarray):
 
 def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
     if not cfg.is_moe:
-        return swiglu(h, _w(p["w_gate"]), _w(p["w_up"]), _w(p["w_down"]))
+        return swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
     if cfg.moe_capacity_factor > 0:
         return _moe_dispatch(cfg, p, h)
     # Mixtral MoE: top-k routing, dense all-experts compute, weighted combine.
@@ -211,17 +212,24 @@ def _moe_dispatch(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
 
     # Queue position of each (choice-rank, token) in its expert's buffer:
     # rank-major order gives first choices priority when capacity binds.
+    # Built one rank at a time so peak temporaries stay [T, E, C] (a
+    # k-expanded [k*T, E, C] buffer would be ~1.3 GB per copy at
+    # Mixtral prefill scale).
     onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [T, k, E]
-    onehot_kt = onehot.transpose(1, 0, 2).reshape(k * t, e)
-    pos = jnp.cumsum(onehot_kt, axis=0) - onehot_kt  # [k*T, E]
-    keep = (pos < cap) * onehot_kt
-    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
-    dispatch_kt = keep[..., None] * slot  # [k*T, E, C]
-    dispatch = dispatch_kt.reshape(k, t, e, cap)
-
-    # combine[t, e, c] = router weight of token t at its slot.
-    combine = jnp.einsum("ktec,tk->tec", dispatch, top_w)
-    disp_mask = dispatch.sum(0)  # [T, E, C] 0/1
+    counts = jnp.zeros((e,), jnp.float32)
+    disp_mask = jnp.zeros((t, e, cap), jnp.float32)
+    combine = jnp.zeros((t, e, cap), jnp.float32)
+    for r in range(k):
+        oh_r = onehot[:, r, :]  # [T, E]
+        pos_r = jnp.cumsum(oh_r, axis=0) - oh_r + counts  # [T, E]
+        keep_r = (pos_r < cap) * oh_r
+        slot_r = (
+            jax.nn.one_hot(pos_r.astype(jnp.int32), cap, dtype=jnp.float32)
+            * keep_r[..., None]
+        )  # [T, E, C]
+        disp_mask = disp_mask + slot_r
+        combine = combine + slot_r * top_w[:, r][:, None, None]
+        counts = counts + oh_r.sum(axis=0)
 
     xin = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp_mask)
     xin = xin.astype(h.dtype)
@@ -272,7 +280,7 @@ def _block(
     else:  # pragma: no cover
         raise ValueError(mode)
 
-    x = x + attn.reshape(*x.shape[:-1], -1) @ _w(p["wo"])
+    x = x + _qmm(attn.reshape(*x.shape[:-1], -1), p["wo"])
     h2 = _rms(cfg, x, p["mlp_norm"])
     x = x + _mlp(cfg, p, h2)
     return x, new_k, new_v
@@ -319,8 +327,14 @@ def _run_layers(
 
 def _unembed(cfg: ModelConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     x = _rms(cfg, x, params["norm_f"])
-    w = params["embed"].T if cfg.tie_embeddings else _w(params["lm_head"])
-    return jnp.einsum("...d,dv->...v", x, w, preferred_element_type=jnp.float32)
+    if cfg.tie_embeddings:
+        return jnp.einsum(
+            "...d,dv->...v",
+            x,
+            params["embed"].T,
+            preferred_element_type=jnp.float32,
+        )
+    return _qmm(x, params["lm_head"], out_dtype=jnp.float32)
 
 
 # ---------------------------------------------------------------------------

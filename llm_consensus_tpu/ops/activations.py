@@ -2,13 +2,18 @@
 
 jnp implementation; the two up-projections and the gate multiply are a
 single fused region under XLA on TPU (the matmuls land on the MXU, the
-silu*gate elementwise fuses into the second matmul's prologue).
+silu*gate elementwise fuses into the second matmul's prologue). Weights
+may be plain arrays or int8 :class:`~llm_consensus_tpu.ops.quant.
+QuantizedTensor` leaves — matmuls route through the quantization-aware
+dispatcher either way.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from llm_consensus_tpu.ops.quant import matmul as _qmm
 
 
 def swiglu(
@@ -19,7 +24,8 @@ def swiglu(
 ) -> jnp.ndarray:
     """SwiGLU feed-forward: silu(x @ w_gate) * (x @ w_up) @ w_down.
 
-    x: [..., d_model]; w_gate/w_up: [d_model, d_ff]; w_down: [d_ff, d_model].
+    x: [..., d_model]; w_gate/w_up: [d_model, d_ff]; w_down: [d_ff, d_model]
+    (each a plain array or a QuantizedTensor).
     """
-    gate = jax.nn.silu(x @ w_gate)
-    return (gate * (x @ w_up)) @ w_down
+    gate = jax.nn.silu(_qmm(x, w_gate))
+    return _qmm(gate * _qmm(x, w_up), w_down)

@@ -76,6 +76,56 @@ def maybe_dequantize(leaf, dtype=jnp.bfloat16):
     return leaf
 
 
+# Test hook: None = kernel on TPU only; True/False forces.
+_FORCE_KERNEL: bool | None = None
+
+
+def _use_kernel() -> bool:
+    if _FORCE_KERNEL is not None:
+        return _FORCE_KERNEL
+    # Single-chip TPU only: pallas_call is opaque to GSPMD, so on a
+    # multi-device mesh the kernel would force TP/EP-sharded weights to
+    # be all-gathered — the XLA dequant fallback shards fine there.
+    return jax.default_backend() == "tpu" and jax.device_count() == 1
+
+
+def matmul(x: jnp.ndarray, leaf, out_dtype=None) -> jnp.ndarray:
+    """``x [..., K] @ leaf [K, N]`` — quantization-aware.
+
+    Plain arrays use the regular XLA dot. QuantizedTensor weights use the
+    fused Pallas int8 kernel in the single-chip decode/GEMV regime
+    (small M), where XLA's materialize-the-dequant behavior would
+    otherwise erase the int8 bandwidth win (see
+    ops/pallas/quant_matmul.py); other shapes and sharded runs fall back
+    to dequant + XLA dot.
+    """
+    if isinstance(leaf, QuantizedTensor):
+        if leaf.q.ndim == 2 and _use_kernel():
+            from llm_consensus_tpu.ops.pallas.quant_matmul import (
+                quant_matmul_2d,
+                quant_matmul_supported,
+            )
+
+            lead = x.shape[:-1]
+            k, n = leaf.q.shape
+            m = 1
+            for s in lead:
+                m *= s
+            if quant_matmul_supported(m, k, n):
+                out = quant_matmul_2d(
+                    x.reshape(m, k), leaf.q, leaf.scale, out_dtype=out_dtype
+                )
+                return out.reshape(*lead, n)
+        w = dequantize(leaf, x.dtype)
+    else:
+        w = leaf
+    if out_dtype is not None:
+        return jnp.einsum(
+            "...k,kn->...n", x, w, preferred_element_type=out_dtype
+        )
+    return x @ w
+
+
 def quantize_params(params: dict, *, quantize_lm_head: bool = True) -> dict:
     """Quantize the large matmul weights of an ``init_params`` tree.
 

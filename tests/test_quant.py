@@ -100,6 +100,59 @@ def test_quantized_params_shard_tensor_parallel(cpu_devices):
     assert out.shape == (2, 8, cfg.vocab_size)
 
 
+def test_quant_matmul_kernel_matches_fallback():
+    """ops.quant.matmul: Pallas int8 kernel (interpret) == XLA dequant."""
+    from llm_consensus_tpu.ops import quant as quant_mod
+    from llm_consensus_tpu.ops.pallas.quant_matmul import (
+        quant_matmul_supported,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 1, 256), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (256, 384), jnp.float32)
+    qt = quantize_tensor(w, axis=0)
+    assert quant_matmul_supported(2, 256, 384)
+    ref = quant_mod.matmul(x, qt)  # CPU default: XLA fallback
+    quant_mod._FORCE_KERNEL = True
+    try:
+        out = quant_mod.matmul(x, qt)
+    finally:
+        quant_mod._FORCE_KERNEL = None
+    assert out.shape == ref.shape == (2, 1, 384)
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.02
+
+
+def test_quantized_decode_with_kernel_matches_xla_path():
+    """End-to-end decode step with the kernel forced on (interpret)."""
+    from llm_consensus_tpu.models.cache import KVCache
+    from llm_consensus_tpu.models.transformer import decode_step, prefill
+    from llm_consensus_tpu.ops import quant as quant_mod
+
+    cfg = get_config("test-tiny")  # d_model=64 < 128: unsupported shapes
+    cfg = cfg.with_(d_model=128, n_heads=4, n_kv_heads=2, d_ff=256)
+    params = quantize_params(
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    )
+    tokens = jnp.ones((2, 8), jnp.int32)
+    lengths = jnp.full((2,), 8, jnp.int32)
+
+    def run():
+        cache = KVCache.create(cfg, 2, 16, dtype=jnp.float32)
+        logits, cache = prefill(cfg, params, tokens, lengths, cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        logits2, _ = decode_step(cfg, params, tok[:, None], cache)
+        return logits2
+
+    ref = run()
+    quant_mod._FORCE_KERNEL = True
+    try:
+        out = run()
+    finally:
+        quant_mod._FORCE_KERNEL = None
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.05
+
+
 def test_engine_quant_config():
     """EngineConfig(quant='int8') quantizes at init; bad mode rejected."""
     cfg = get_config("test-tiny")
