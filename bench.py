@@ -48,6 +48,12 @@ def main() -> int:
         choices=("none", "int8"),
         help="weight-only quantization (int8 halves decode HBM traffic)",
     )
+    p.add_argument(
+        "--kv-quant",
+        default="int8",
+        choices=("none", "int8"),
+        help="KV-cache quantization (the dominant HBM term at large N)",
+    )
     args = p.parse_args()
 
     if args.cpu:
@@ -86,6 +92,7 @@ def main() -> int:
             eos_id=-1,  # never stop early: fixed work per run
             # Self-consistency semantics: N candidates share one prompt.
             shared_prefill=not args.no_shared_prefill,
+            kv_quant=args.kv_quant == "int8",
         )
         return out.tokens
 
@@ -110,7 +117,8 @@ def main() -> int:
         json.dumps(
             {
                 "metric": f"candidate-tokens/sec/chip ({cfg.name}, N={b}, "
-                f"decode {args.new_tokens} @ prompt {s}, quant={args.quant})",
+                f"decode {args.new_tokens} @ prompt {s}, quant={args.quant}, "
+                f"kv={args.kv_quant})",
                 "value": round(tps_per_chip, 2),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tps_per_chip / 1000.0, 4),

@@ -43,6 +43,9 @@ class EngineConfig:
     # "int8" = weight-only per-channel quantization at engine init
     # (ops/quant.py): halves weight HBM traffic on the decode hot loop.
     quant: str = "none"
+    # int8 KV cache (models/cache.QuantKVCache): halves cache HBM
+    # traffic per decode step (the dominant term at large N).
+    kv_quant: bool = False
 
 
 @dataclass
@@ -184,6 +187,7 @@ class InferenceEngine:
             eos_id=self.tokenizer.eos_id,
             pad_id=self.tokenizer.pad_id,
             shared_prefill=shared,
+            kv_quant=self.config.kv_quant,
         )
         toks = np.asarray(out.tokens)
         nums = np.asarray(out.num_tokens)

@@ -23,9 +23,30 @@ import jax
 import jax.numpy as jnp
 
 from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
-from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.cache import KVCache, QuantKVCache
 from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.models.transformer import decode_step, prefill
+
+
+def _broadcast_cache(cache1, b: int):
+    """Broadcast a B=1 cache's buffers to B rows (length included)."""
+
+    def bc(x):
+        return jnp.broadcast_to(x, (x.shape[0], b, *x.shape[2:]))
+
+    if isinstance(cache1, QuantKVCache):
+        return QuantKVCache(
+            k_q=bc(cache1.k_q),
+            v_q=bc(cache1.v_q),
+            k_scale=bc(cache1.k_scale),
+            v_scale=bc(cache1.v_scale),
+            length=jnp.broadcast_to(cache1.length, (b,)),
+        )
+    return KVCache(
+        k=bc(cache1.k),
+        v=bc(cache1.v),
+        length=jnp.broadcast_to(cache1.length, (b,)),
+    )
 
 
 @jax.tree_util.register_dataclass
@@ -46,6 +67,7 @@ class GenerateOutput:
         "pad_id",
         "cache_len",
         "shared_prefill",
+        "kv_quant",
     ),
 )
 def generate(
@@ -62,6 +84,7 @@ def generate(
     pad_id: int = 0,
     cache_len: int | None = None,
     shared_prefill: bool = False,
+    kv_quant: bool = False,
 ) -> GenerateOutput:
     """Generate up to ``max_new_tokens`` for a batch of right-padded prompts.
 
@@ -77,26 +100,19 @@ def generate(
             f"cache_len {cache_len} < prompt {s} + max_new_tokens {max_new_tokens}"
         )
 
+    make_cache = QuantKVCache.create if kv_quant else KVCache.create
     if shared_prefill:
         # Self-consistency fan-out: all B rows decode from the SAME
         # prompt, so prefill once at B=1 and broadcast the cache — saves
         # (B-1)/B of the prefill FLOPs (BASELINE.json's N-way configs).
-        cache1 = KVCache.create(cfg, 1, cache_len)
+        cache1 = make_cache(cfg, 1, cache_len)
         logits1, cache1 = prefill(
             cfg, params, tokens[:1], lengths[:1], cache1
         )
         logits = jnp.broadcast_to(logits1, (b, logits1.shape[-1]))
-        cache = KVCache(
-            k=jnp.broadcast_to(
-                cache1.k, (cache1.k.shape[0], b, *cache1.k.shape[2:])
-            ),
-            v=jnp.broadcast_to(
-                cache1.v, (cache1.v.shape[0], b, *cache1.v.shape[2:])
-            ),
-            length=jnp.broadcast_to(cache1.length, (b,)),
-        )
+        cache = _broadcast_cache(cache1, b)
     else:
-        cache = KVCache.create(cfg, b, cache_len)
+        cache = make_cache(cfg, b, cache_len)
         logits, cache = prefill(cfg, params, tokens, lengths, cache)
 
     key0 = jax.random.fold_in(key, 0)

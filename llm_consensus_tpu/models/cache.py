@@ -47,3 +47,72 @@ class KVCache:
 
     def with_length(self, length: jnp.ndarray) -> "KVCache":
         return KVCache(k=self.k, v=self.v, length=length)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QuantKVCache:
+    """int8 KV cache: per-(token, kv-head) symmetric scales.
+
+    Decode re-reads the whole cache every step, so at large candidate
+    counts the cache rivals the weights for HBM traffic (llama-1b N=64:
+    ~2.1 GB/step bf16). int8 halves it; scales are per-(position, head)
+    amax over head_dim, which preserves decode logits to ~1%% (tested
+    against the bf16 cache).
+
+    Layout is head-major ``[L, B, Hkv, S, D]`` (unlike KVCache's
+    ``[L, B, S, Hkv, D]``): the int8 decode-attention kernel reads
+    per-(batch, head) [S, D] slabs, and head-major makes that a
+    zero-copy reshape instead of a per-step transposed materialization.
+    """
+
+    # [n_layers, B, n_kv_heads, max_len, head_dim] int8
+    k_q: jnp.ndarray
+    v_q: jnp.ndarray
+    # [n_layers, B, n_kv_heads, max_len] float32
+    k_scale: jnp.ndarray
+    v_scale: jnp.ndarray
+    length: jnp.ndarray  # [B]
+
+    @staticmethod
+    def create(cfg: ModelConfig, batch: int, max_len: int) -> "QuantKVCache":
+        shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+        sshape = shape[:-1]
+        return QuantKVCache(
+            k_q=jnp.zeros(shape, jnp.int8),
+            v_q=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(sshape, jnp.float32),
+            v_scale=jnp.zeros(sshape, jnp.float32),
+            length=jnp.zeros((batch,), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k_q.shape[3]
+
+    def advanced(self, n: int | jnp.ndarray = 1) -> "QuantKVCache":
+        return QuantKVCache(
+            k_q=self.k_q,
+            v_q=self.v_q,
+            k_scale=self.k_scale,
+            v_scale=self.v_scale,
+            length=self.length + n,
+        )
+
+    def with_length(self, length: jnp.ndarray) -> "QuantKVCache":
+        return QuantKVCache(
+            k_q=self.k_q,
+            v_q=self.v_q,
+            k_scale=self.k_scale,
+            v_scale=self.v_scale,
+            length=length,
+        )
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., D] -> (int8 [..., D], f32 scale [...]) amax-symmetric over D."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale

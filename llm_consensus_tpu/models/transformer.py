@@ -33,7 +33,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.cache import KVCache, QuantKVCache, quantize_kv
 from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.ops.activations import swiglu
 from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
@@ -80,6 +80,25 @@ def _attn_decode(cfg: ModelConfig, q, k_cache, v_cache, valid_len):
         return flash_decode_attention(q, k_cache, v_cache, valid_len)
     return decode_attention(
         q, k_cache, v_cache, valid_len, window=cfg.sliding_window
+    )
+
+
+def _attn_decode_quant(cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len):
+    """int8-cache decode attention: Pallas on single-chip TPU (the whole
+    point of the quantized cache is reading int8 from HBM), jnp dequant
+    elsewhere — pallas_call is opaque to GSPMD, so sharded meshes must
+    take the shardable jnp path (same rule as ops.quant._use_kernel)."""
+    use_kernel = (
+        cfg.use_pallas or jax.default_backend() == "tpu"
+    ) and jax.device_count() == 1
+    if use_kernel and cfg.sliding_window == 0:
+        from llm_consensus_tpu.ops.pallas import flash_decode_attention_q8
+
+        return flash_decode_attention_q8(q, k_q, k_s, v_q, v_s, valid_len)
+    from llm_consensus_tpu.ops.attention import decode_attention_quant
+
+    return decode_attention_quant(
+        q, k_q, k_s, v_q, v_s, valid_len, window=cfg.sliding_window
     )
 
 # ---------------------------------------------------------------------------
@@ -246,13 +265,17 @@ def _block(
     x: jnp.ndarray,
     cos: jnp.ndarray,
     sin: jnp.ndarray,
-    k_layer: jnp.ndarray | None,
-    v_layer: jnp.ndarray | None,
+    kv_layer: tuple | None,
     mode: str,
     valid_len: jnp.ndarray | None,
     positions: jnp.ndarray | None,
 ):
-    """One transformer block. Returns (x, new_k_layer, new_v_layer)."""
+    """One transformer block.
+
+    ``kv_layer``: this layer's cache leaves — (k, v) for the bf16 cache,
+    (k_q, v_q, k_scale, v_scale) for the int8 cache (head-major). Returns
+    (x, new_kv_layer_tuple_or_None).
+    """
     h = _rms(cfg, x, p["attn_norm"])
     q, k, v = _project_qkv(cfg, p, h)
     q = apply_rope(q, cos, sin)
@@ -260,30 +283,59 @@ def _block(
 
     if mode == "full":
         attn = _attn_causal(cfg, q, k, v, positions)
-        new_k = new_v = None
+        new_kv = None
     elif mode == "prefill":
         attn = _attn_causal(cfg, q, k, v, positions)
         s = k.shape[1]
-        new_k = k_layer.at[:, :s].set(k.astype(k_layer.dtype))
-        new_v = v_layer.at[:, :s].set(v.astype(v_layer.dtype))
+        if len(kv_layer) == 2:
+            k_l, v_l = kv_layer
+            new_kv = (
+                k_l.at[:, :s].set(k.astype(k_l.dtype)),
+                v_l.at[:, :s].set(v.astype(v_l.dtype)),
+            )
+        else:
+            kq_l, vq_l, ks_l, vs_l = kv_layer
+            kq, ks = quantize_kv(k)  # [B,S,Hkv,D] / [B,S,Hkv]
+            vq, vs = quantize_kv(v)
+            new_kv = (
+                kq_l.at[:, :, :s].set(kq.transpose(0, 2, 1, 3)),
+                vq_l.at[:, :, :s].set(vq.transpose(0, 2, 1, 3)),
+                ks_l.at[:, :, :s].set(ks.transpose(0, 2, 1)),
+                vs_l.at[:, :, :s].set(vs.transpose(0, 2, 1)),
+            )
     elif mode == "decode":
         b = x.shape[0]
         batch_idx = jnp.arange(b)
         # valid_len is the pre-write fill length; write the new token there.
-        new_k = k_layer.at[batch_idx, valid_len].set(
-            k[:, 0].astype(k_layer.dtype)
-        )
-        new_v = v_layer.at[batch_idx, valid_len].set(
-            v[:, 0].astype(v_layer.dtype)
-        )
-        attn = _attn_decode(cfg, q, new_k, new_v, valid_len + 1)
+        if len(kv_layer) == 2:
+            k_l, v_l = kv_layer
+            new_k = k_l.at[batch_idx, valid_len].set(
+                k[:, 0].astype(k_l.dtype)
+            )
+            new_v = v_l.at[batch_idx, valid_len].set(
+                v[:, 0].astype(v_l.dtype)
+            )
+            new_kv = (new_k, new_v)
+            attn = _attn_decode(cfg, q, new_k, new_v, valid_len + 1)
+        else:
+            kq_l, vq_l, ks_l, vs_l = kv_layer
+            kq1, ks1 = quantize_kv(k[:, 0])  # [B,Hkv,D] / [B,Hkv]
+            vq1, vs1 = quantize_kv(v[:, 0])
+            new_kq = kq_l.at[batch_idx, :, valid_len].set(kq1)
+            new_vq = vq_l.at[batch_idx, :, valid_len].set(vq1)
+            new_ks = ks_l.at[batch_idx, :, valid_len].set(ks1)
+            new_vs = vs_l.at[batch_idx, :, valid_len].set(vs1)
+            new_kv = (new_kq, new_vq, new_ks, new_vs)
+            attn = _attn_decode_quant(
+                cfg, q, new_kq, new_ks, new_vq, new_vs, valid_len + 1
+            )
     else:  # pragma: no cover
         raise ValueError(mode)
 
     x = x + _qmm(attn.reshape(*x.shape[:-1], -1), p["wo"])
     h2 = _rms(cfg, x, p["mlp_norm"])
     x = x + _mlp(cfg, p, h2)
-    return x, new_k, new_v
+    return x, new_kv
 
 
 def _run_layers(
@@ -304,7 +356,7 @@ def _run_layers(
     if mode == "full":
 
         def body(carry, p):
-            y, _, _ = _block(cfg, p, carry, cos, sin, None, None, "full", None, positions)
+            y, _ = _block(cfg, p, carry, cos, sin, None, "full", None, positions)
             return y, None
 
         if remat:
@@ -312,17 +364,24 @@ def _run_layers(
         x, _ = jax.lax.scan(body, x, blocks)
         return x, cache
 
+    if isinstance(cache, QuantKVCache):
+        kv_leaves = (cache.k_q, cache.v_q, cache.k_scale, cache.v_scale)
+    else:
+        kv_leaves = (cache.k, cache.v)
+
     def body(carry, layer_in):
-        p, k_l, v_l = layer_in
-        y, nk, nv = _block(
-            cfg, p, carry, cos, sin, k_l, v_l, mode, valid_len, positions
+        p = layer_in[0]
+        y, new_kv = _block(
+            cfg, p, carry, cos, sin, layer_in[1:], mode, valid_len, positions
         )
-        return y, (nk, nv)
+        return y, new_kv
 
     if remat:
         body = jax.checkpoint(body)
-    x, (new_k, new_v) = jax.lax.scan(body, x, (blocks, cache.k, cache.v))
-    return x, KVCache(k=new_k, v=new_v, length=cache.length)
+    x, new_leaves = jax.lax.scan(body, x, (blocks, *kv_leaves))
+    if isinstance(cache, QuantKVCache):
+        return x, QuantKVCache(*new_leaves, length=cache.length)
+    return x, KVCache(k=new_leaves[0], v=new_leaves[1], length=cache.length)
 
 
 def _unembed(cfg: ModelConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
@@ -440,7 +499,7 @@ def decode_step_paged(
         attn = decode_attention(
             q, k_seq, v_seq, pos + 1, window=cfg.sliding_window
         )
-        y = carry + attn.reshape(*carry.shape[:-1], -1) @ _w(p["wo"])
+        y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
         y = y + _mlp(cfg, p, h2)
         return y, (k_pool, v_pool)

@@ -76,6 +76,35 @@ def causal_attention(
     return _gqa_out(probs, v, q.dtype)
 
 
+def decode_attention_quant(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    window: int = 0,
+) -> jnp.ndarray:
+    """Decode attention over an int8 cache (jnp reference path).
+
+    q: [B, 1, H, D]; k_q/v_q: [B, Hkv, S, D] int8 (head-major,
+    QuantKVCache layout); k_scale/v_scale: [B, Hkv, S] f32.
+    Dequantizes and defers to :func:`decode_attention` — correct
+    everywhere, but materializes the bf16 cache; the Pallas kernel
+    (ops/pallas.flash_decode_attention_q8) is the TPU hot path.
+    """
+    k = (k_q.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+    v = (v_q.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
+    # [B, Hkv, S, D] -> [B, S, Hkv, D]
+    return decode_attention(
+        q,
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        valid_len,
+        window=window,
+    )
+
+
 def decode_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,

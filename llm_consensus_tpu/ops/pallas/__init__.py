@@ -2,9 +2,10 @@
 
 The reference has no native compute of its own (its FLOPs live behind the
 Gemini API, ``src/main.rs:82-86``); these kernels are the TPU build's
-"native op" layer per SURVEY.md §7 step 1 — fused attention (prefill and
-cached decode) and RMSNorm that keep the softmax pipeline in VMEM instead
-of round-tripping score matrices through HBM.
+"native op" layer per SURVEY.md §7 step 1 — fused attention (prefill,
+cached decode, int8-cache decode), RMSNorm, and the fused int8-dequant
+matmul that keep score matrices / dequantized weights in VMEM instead of
+round-tripping through HBM.
 
 On non-TPU backends the kernels run in Pallas interpret mode (tests), and
 every wrapper has a jnp reference twin in :mod:`llm_consensus_tpu.ops`
@@ -14,11 +15,15 @@ used for numerics cross-checks.
 from llm_consensus_tpu.ops.pallas.attention import (
     flash_causal_attention,
     flash_decode_attention,
+    flash_decode_attention_q8,
 )
 from llm_consensus_tpu.ops.pallas.norms import fused_rms_norm
+from llm_consensus_tpu.ops.pallas.quant_matmul import quant_matmul_2d
 
 __all__ = [
     "flash_causal_attention",
     "flash_decode_attention",
+    "flash_decode_attention_q8",
     "fused_rms_norm",
+    "quant_matmul_2d",
 ]

@@ -177,6 +177,104 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _decode_q8_kernel(
+    len_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, o_ref, *, scale: float
+):
+    """One (batch, kv-head) program over an int8 cache.
+
+    len_ref: [1] SMEM; q_ref: [1, 1, G, D]; kq_ref/vq_ref: [1, S, D] int8;
+    ks_ref/vs_ref: [1, S] f32; o_ref: [1, 1, G, D]. K/V dequantize
+    in-register — HBM reads stay int8 (+ one f32 scale per slot).
+    """
+    _, _, g, d = q_ref.shape
+    s = kq_ref.shape[1]
+    valid = len_ref[0]
+
+    k = kq_ref[0].astype(jnp.float32) * ks_ref[0][:, None]  # [S, D]
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+    scores = jax.lax.dot_general(
+        q,
+        k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [G, S]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
+    scores = jnp.where(slot < valid, scores, _NEG_INF)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+
+    v = vq_ref[0].astype(jnp.float32) * vs_ref[0][:, None]  # [S, D]
+    out = jax.lax.dot_general(
+        p,
+        v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [G, D]
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def flash_decode_attention_q8(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Decode attention over the int8 head-major cache.
+
+    q: [B, 1, H, D]; k_q/v_q: [B, Hkv, S, D] int8 (QuantKVCache layout —
+    the reshape to per-(b, head) [S, D] slabs is zero-copy, unlike the
+    bf16 kernel's transpose); k_scale/v_scale: [B, Hkv, S] f32;
+    valid_len: [B]. Returns [B, 1, H, D] in q's dtype.
+    """
+    b, _, h, d = q.shape
+    hkv, s = k_q.shape[1], k_q.shape[2]
+    g = h // hkv
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+
+    q4 = q.reshape(b, 1, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b * hkv, 1, g, d
+    )
+    kq2 = k_q.reshape(b * hkv, s, d)
+    vq2 = v_q.reshape(b * hkv, s, d)
+    ks2 = k_scale.reshape(b * hkv, s)
+    vs2 = v_scale.reshape(b * hkv, s)
+    lens = jnp.repeat(valid_len.astype(jnp.int32), hkv)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_q8_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, 1, g, d), q.dtype),
+        grid=(b * hkv,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda bh: (bh,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (1, 1, g, d), lambda bh: (bh, 0, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, s, d), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, s), lambda bh: (bh, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, s, d), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, s), lambda bh: (bh, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda bh: (bh, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(lens, q4, kq2, ks2, vq2, vs2)
+    return (
+        out.reshape(b, hkv, 1, g, d).transpose(0, 2, 1, 3, 4).reshape(b, 1, h, d)
+    )
+
+
 def flash_decode_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,

@@ -73,9 +73,11 @@ def quant_matmul_2d(
     k2, n = w_q.shape
     if k != k2:
         raise ValueError(f"contraction mismatch {k} vs {k2}")
-    blk_n = _pick_block(n)
+    blk_n = _pick_block(n, target=_blk_target(k))
     if blk_n is None:
-        raise ValueError(f"N={n} has no 128-aligned divisor block")
+        raise ValueError(
+            f"N={n} (K={k}) has no 128-aligned block within the VMEM budget"
+        )
     if interpret is None:
         interpret = _interpret_default()
     out_dtype = out_dtype or x.dtype
@@ -96,10 +98,18 @@ def quant_matmul_2d(
     )(x.astype(jnp.bfloat16), w_q, scale.astype(jnp.float32))
 
 
-# VMEM budget heuristic: x + one weight block + out block must fit
-# comfortably. x is the variable piece; cap its rows.
+# VMEM budget heuristic (~16 MB/core): x + one int8 weight tile
+# (double-buffered by the grid pipeline) + out block must fit.
 _MAX_M = 256
 _MAX_X_BYTES = 4 * 1024 * 1024
+_MAX_W_TILE_BYTES = 4 * 1024 * 1024  # int8 K x blk_n, x2 for double-buffer
+
+
+def _blk_target(k: int) -> int:
+    """Largest 128-multiple blk_n keeping the K x blk_n int8 tile in
+    budget (capped at 512 — wider tiles stop helping)."""
+    by_vmem = (_MAX_W_TILE_BYTES // max(k, 1)) // 128 * 128
+    return max(128, min(512, by_vmem))
 
 
 def quant_matmul_supported(m: int, k: int, n: int) -> bool:
@@ -107,6 +117,7 @@ def quant_matmul_supported(m: int, k: int, n: int) -> bool:
         m <= _MAX_M
         and m * k * 2 <= _MAX_X_BYTES
         and n % 128 == 0
-        and _pick_block(n) is not None
         and k % 128 == 0
+        and k * 128 <= _MAX_W_TILE_BYTES  # smallest tile must fit
+        and _pick_block(n, target=_blk_target(k)) is not None
     )

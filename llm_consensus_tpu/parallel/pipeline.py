@@ -73,7 +73,7 @@ def _stage_chunk(cfg: ModelConfig, blocks, x, cos, sin, remat: bool):
     """Scan this stage's local layer chunk over activations ``x``."""
 
     def body(carry, p):
-        y, _, _ = _block(cfg, p, carry, cos, sin, None, None, "full", None, None)
+        y, _ = _block(cfg, p, carry, cos, sin, None, "full", None, None)
         return y, None
 
     if remat:
