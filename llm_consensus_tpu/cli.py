@@ -50,6 +50,10 @@ def _build_backend(args):
     # and the fake path must stay instant.
     import jax
 
+    from llm_consensus_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from llm_consensus_tpu.backends.local import LocalBackend
     from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
     from llm_consensus_tpu.engine.tokenizer import load_tokenizer

@@ -98,6 +98,9 @@ def load():
         lib.rt_loader_destroy.argtypes = [ctypes.c_void_p]
         lib.rt_loader_n_tokens.restype = ctypes.c_int64
         lib.rt_loader_n_tokens.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "rt_loader_skip"):  # older built libs lack it
+            lib.rt_loader_skip.restype = ctypes.c_int
+            lib.rt_loader_skip.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -257,6 +260,17 @@ class NativeLoader:
         if rc != 0:
             raise RuntimeError("loader stopped")
         return out
+
+    def skip(self, n: int) -> None:
+        """Discard n batches in C (checkpoint-resume fast-forward)."""
+        if n <= 0:
+            return
+        if hasattr(self._lib, "rt_loader_skip"):
+            if self._lib.rt_loader_skip(self._h, n) != 0:
+                raise RuntimeError("loader stopped")
+        else:  # old lib: draw-and-discard (correct, slower)
+            for _ in range(n):
+                self.next()
 
     def close(self) -> None:
         if getattr(self, "_h", None):

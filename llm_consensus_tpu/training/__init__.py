@@ -7,6 +7,11 @@ conditioning scales past prompt engineering — and the same sharded train
 step is the multi-chip dry-run surface (``__graft_entry__.dryrun_multichip``).
 """
 
+from llm_consensus_tpu.training.loop import (
+    LoopConfig,
+    TrainReport,
+    run_training,
+)
 from llm_consensus_tpu.training.train import (
     TrainConfig,
     TrainState,
@@ -17,10 +22,13 @@ from llm_consensus_tpu.training.train import (
 )
 
 __all__ = [
+    "LoopConfig",
     "TrainConfig",
+    "TrainReport",
     "TrainState",
     "causal_lm_loss",
     "make_optimizer",
     "make_sharded_train_step",
     "make_train_step",
+    "run_training",
 ]

@@ -39,6 +39,8 @@ class TokenBatchLoader:
     ):
         self.path = Path(path)
         self.batch, self.seq = batch, seq
+        self._seed = seed
+        self._drawn = 0
         self._native = None
         if prefer_native:
             try:
@@ -63,6 +65,40 @@ class TokenBatchLoader:
         return self._native is not None
 
     @property
+    def position(self) -> int:
+        """Number of batches drawn so far (for exact training resume)."""
+        return self._drawn
+
+    def seek(self, position: int) -> None:
+        """Reposition the stream so the next batch is batch ``position``
+        of a fresh same-seed loader (checkpoint-resume determinism).
+
+        Pure-numpy path fast-forwards the RNG without touching token
+        data; the native path redraws (it owns its RNG in C).
+        """
+        if position < self._drawn:
+            # Restart the stream from the beginning.
+            if self._native is not None:
+                from llm_consensus_tpu.native import NativeLoader
+
+                self._native.close()
+                self._native = NativeLoader(
+                    self.path, self.batch, self.seq, self._seed
+                )
+            else:
+                self._rng = np.random.default_rng(self._seed)
+            self._drawn = 0
+        if self._native is not None:
+            self._native.skip(position - self._drawn)
+            self._drawn = position
+        else:
+            while self._drawn < position:
+                self._rng.integers(
+                    0, self._tokens.size - self.seq, size=self.batch
+                )
+                self._drawn += 1
+
+    @property
     def n_tokens(self) -> int:
         if self._native is not None:
             return self._native.n_tokens
@@ -78,6 +114,7 @@ class TokenBatchLoader:
             toks = np.stack(
                 [self._tokens[s : s + self.seq] for s in starts]
             )
+        self._drawn += 1
         mask = np.ones_like(toks, np.float32)
         return toks, mask
 

@@ -1,0 +1,40 @@
+"""Persistent XLA compilation cache.
+
+First TPU compilation of the decode program costs 20-40 s; a persistent
+cache makes repeat CLI/serving launches near-instant. Off by default in
+JAX; this turns it on with sane thresholds. (Reference counterpart: none
+— it compiles nothing, SURVEY.md §0.)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+_DEFAULT = "~/.cache/llm_consensus_tpu/xla"
+
+
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Enable the persistent compile cache at ``path`` (idempotent).
+
+    Honors ``LLM_CONSENSUS_CACHE_DIR``; returns the directory used, or
+    None if enabling failed (old jax, read-only fs) — callers proceed
+    either way.
+    """
+    import jax
+
+    cache_dir = str(
+        Path(
+            path or os.environ.get("LLM_CONSENSUS_CACHE_DIR", _DEFAULT)
+        ).expanduser()
+    )
+    try:
+        Path(cache_dir).mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        return cache_dir
+    except Exception as e:  # noqa: BLE001 - cache is best-effort
+        log.warning("compilation cache disabled: %s", e)
+        return None

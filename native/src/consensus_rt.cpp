@@ -241,6 +241,21 @@ int rt_loader_next(void* h, int32_t* out) {
   return 0;
 }
 
+// Consume and discard n batches (checkpoint-resume fast-forward): the
+// stream stays byte-identical to n rt_loader_next calls, without the
+// out-copy or a caller-side buffer per skipped batch.
+int rt_loader_skip(void* h, int64_t n) {
+  auto* l = static_cast<RtLoader*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    std::unique_lock<std::mutex> lk(l->mu);
+    l->cv_ready.wait(lk, [&] { return l->stop.load() || !l->ready.empty(); });
+    if (l->ready.empty()) return 1;
+    l->ready.pop_front();
+    l->cv_space.notify_one();
+  }
+  return 0;
+}
+
 void rt_loader_destroy(void* h) {
   auto* l = static_cast<RtLoader*>(h);
   l->stop.store(true);
