@@ -195,9 +195,14 @@ def pipeline_causal_lm_loss(
     tokens: jnp.ndarray,
     loss_mask: jnp.ndarray,
     remat: bool = True,
+    compute_dtype: str | None = None,
 ) -> jnp.ndarray:
     """Masked next-token CE over a pipelined forward (matches
-    ``training.train.causal_lm_loss`` numerics: sum(nll)/sum(mask))."""
+    ``training.train.causal_lm_loss`` numerics: sum(nll)/sum(mask),
+    including its mixed-precision ``compute_dtype`` cast)."""
+    from llm_consensus_tpu.training.train import _cast_params
+
+    params = _cast_params(params, compute_dtype)
     n_stages = mesh.shape["pipe"]
     m = n_microbatches
     b, s = tokens.shape
@@ -249,7 +254,14 @@ def make_pipeline_train_step(cfg, tcfg, mesh: Mesh, n_microbatches: int):
     def step(state, tokens, loss_mask):
         def loss_fn(p):
             return pipeline_causal_lm_loss(
-                cfg, mesh, n_microbatches, p, tokens, loss_mask, tcfg.remat
+                cfg,
+                mesh,
+                n_microbatches,
+                p,
+                tokens,
+                loss_mask,
+                tcfg.remat,
+                tcfg.compute_dtype,
             )
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)

@@ -115,6 +115,9 @@ class ContinuousBatcher:
         # index), so sampling is reproducible regardless of batch-mates.
         self._seeds = np.zeros((c.max_slots,), np.int32)
         self._counts = np.zeros((c.max_slots,), np.int32)
+        self._completed = 0
+        self._generated_tokens = 0
+        self._decode_steps = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._work = threading.Event()
@@ -189,6 +192,21 @@ class ContinuousBatcher:
             self._waiting.append(req)
         self._work.set()
         return req.future
+
+    def stats(self) -> dict:
+        """Live serving counters — a consistent snapshot (the worker
+        mutates slots/pages/counters under the same lock)."""
+        with self._lock:
+            return {
+                "active_slots": sum(s is not None for s in self._slots),
+                "max_slots": self.config.max_slots,
+                "waiting": len(self._waiting),
+                "free_pages": len(self._free_pages),
+                "total_pages": self.config.n_pages - 1,
+                "completed_requests": self._completed,
+                "generated_tokens": self._generated_tokens,
+                "decode_steps": self._decode_steps,
+            }
 
     def close(self) -> None:
         self._stop.set()
@@ -275,7 +293,8 @@ class ContinuousBatcher:
                 generated=[first],
                 prompt_len=len(req.prompt_ids),
             )
-            self._slots[free_slot] = slot
+            with self._lock:
+                self._slots[free_slot] = slot
             self._last_tokens[free_slot] = first
             self._seeds[free_slot] = req.seed
             self._counts[free_slot] = 1  # token 0 sampled from prefill
@@ -286,8 +305,11 @@ class ContinuousBatcher:
         slot = self._slots[idx]
         assert slot is not None
         self.cache = release_seq(self.cache, jnp.int32(idx))
-        self._free_pages.extend(slot.pages)
-        self._slots[idx] = None
+        with self._lock:
+            self._free_pages.extend(slot.pages)
+            self._slots[idx] = None
+            self._completed += 1
+            self._generated_tokens += len(slot.generated)
         ids = [
             t for t in slot.generated if t != self.tokenizer.eos_id
         ]
@@ -308,6 +330,8 @@ class ContinuousBatcher:
             jnp.asarray(self._counts),
             jnp.asarray(temps),
         )
+        with self._lock:
+            self._decode_steps += 1
         next_np = np.asarray(next_tok)
         for i, slot in enumerate(self._slots):
             if slot is None:

@@ -60,6 +60,69 @@ class TrainReport:
     resumed_from: int | None = None
 
 
+_LATEST = "LATEST"
+
+
+def _latest_checkpoint(ckpt_dir: str) -> Path | None:
+    """Resolve the newest COMPLETE checkpoint under ``ckpt_dir``.
+
+    Checkpoints are versioned subdirectories committed by atomically
+    updating a LATEST pointer file after the save finishes — a crash
+    mid-save leaves a dangling step dir but LATEST still names the last
+    complete one, so state and metadata can never mismatch. Falls back
+    to ``ckpt_dir`` itself for legacy flat layouts.
+    """
+    root = Path(ckpt_dir)
+    pointer = root / _LATEST
+    if pointer.exists():
+        candidate = root / pointer.read_text().strip()
+        if (candidate / "state").exists():
+            return candidate
+    if (root / "state").exists():  # legacy flat layout
+        return root
+    return None
+
+
+def _save_checkpoint(ckpt_dir: str, state, done: int, loader) -> None:
+    from llm_consensus_tpu.checkpoint.io import save_train_state
+
+    root = Path(ckpt_dir)
+    step_dir = root / f"step_{done}"
+    # State passes through as-is: orbax handles sharded arrays (each
+    # host writes its shards); gathering to host would break multi-host
+    # and triple host RAM.
+    save_train_state(
+        step_dir,
+        state,
+        extra={
+            "step": done,
+            "loader_position": getattr(loader, "position", 0),
+        },
+    )
+    # Commit: atomic pointer swap. Readers never see a half-written
+    # checkpoint as current.
+    tmp = root / (_LATEST + ".tmp")
+    tmp.write_text(step_dir.name)
+    tmp.replace(root / _LATEST)
+    # Prune everything older than the two newest complete checkpoints.
+    keep = {step_dir.name}
+    steps = sorted(
+        (
+            int(p.name.split("_")[1])
+            for p in root.glob("step_*")
+            if p.name != step_dir.name and (p / "state").exists()
+        ),
+        reverse=True,
+    )
+    keep.update(f"step_{s}" for s in steps[:1])
+    import shutil
+
+    for p in root.glob("step_*"):
+        if p.name not in keep:
+            shutil.rmtree(p, ignore_errors=True)
+    log.info("checkpointed step %d -> %s", done, step_dir)
+
+
 def _make_step(cfg: ModelConfig, tcfg: TrainConfig, mesh, micro: int):
     if mesh is None:
         step = make_train_step(cfg, tcfg)
@@ -102,24 +165,47 @@ def run_training(
         )
     report = TrainReport(final_step=0)
 
-    if params is None:
-        params = init_params(
-            cfg, jax.random.PRNGKey(loop.seed), dtype=jax.numpy.float32
-        )
-    state = init_train_state(cfg, params, tcfg)
+    resume_dir = _latest_checkpoint(loop.ckpt_dir) if loop.ckpt_dir else None
 
-    # Resume if a checkpoint exists.
     start_step = 0
-    if loop.ckpt_dir and (Path(loop.ckpt_dir) / "state").exists():
+    if resume_dir is not None:
         from llm_consensus_tpu.checkpoint.io import restore_train_state
 
-        state, extra = restore_train_state(loop.ckpt_dir, state)
+        # Abstract template: no point materializing a random init (and
+        # full optimizer moments) just to describe shapes.
+        template = jax.eval_shape(
+            lambda: init_train_state(
+                cfg,
+                params
+                if params is not None
+                else init_params(
+                    cfg, jax.random.PRNGKey(loop.seed), dtype=jax.numpy.float32
+                ),
+                tcfg,
+            )
+        )
+        state, extra = restore_train_state(resume_dir, template)
         extra = extra or {}
         start_step = int(extra.get("step", state.step))
         if "loader_position" in extra and hasattr(loader, "seek"):
             loader.seek(int(extra["loader_position"]))
+        else:
+            log.warning(
+                "resuming at step %d WITHOUT restoring data position "
+                "(meta has loader_position: %s; loader has seek(): %s) — "
+                "the data order will differ from an uninterrupted run",
+                start_step,
+                "loader_position" in extra,
+                hasattr(loader, "seek"),
+            )
         report.resumed_from = start_step
-        log.info("resumed from %s at step %d", loop.ckpt_dir, start_step)
+        log.info("resumed from %s at step %d", resume_dir, start_step)
+    else:
+        if params is None:
+            params = init_params(
+                cfg, jax.random.PRNGKey(loop.seed), dtype=jax.numpy.float32
+            )
+        state = init_train_state(cfg, params, tcfg)
 
     step_fn, place = _make_step(cfg, tcfg, mesh, loop.n_microbatches)
     batch_shardings = None  # captured from the first placed batch
@@ -162,20 +248,7 @@ def run_training(
             tokens_since = 0
 
         if loop.ckpt_every and loop.ckpt_dir and done % loop.ckpt_every == 0:
-            from llm_consensus_tpu.checkpoint.io import save_train_state
-
-            # State passes through as-is: orbax handles sharded arrays
-            # (each host writes its shards); gathering to host would
-            # break multi-host and triple host RAM.
-            save_train_state(
-                loop.ckpt_dir,
-                state,
-                extra={
-                    "step": done,
-                    "loader_position": getattr(loader, "position", 0),
-                },
-            )
-            log.info("checkpointed step %d -> %s", done, loop.ckpt_dir)
+            _save_checkpoint(loop.ckpt_dir, state, done, loader)
 
     report.final_step = loop.total_steps
     return state, report

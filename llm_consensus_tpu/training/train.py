@@ -37,6 +37,11 @@ class TrainConfig:
     warmup_steps: int = 100
     total_steps: int = 10_000
     remat: bool = True
+    # Mixed precision: keep fp32 master params in the train state, cast
+    # to this dtype inside the loss for MXU-speed matmuls (set
+    # "bfloat16" on TPU), with full-precision grads/updates applied to
+    # the masters. None = compute in the params' own dtype.
+    compute_dtype: str | None = None
 
 
 @jax.tree_util.register_dataclass
@@ -63,15 +68,34 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     )
 
 
+def _cast_params(params, dtype: str | None):
+    if not dtype:
+        return params
+    target = jnp.dtype(dtype)
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(target)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
+
+
 def causal_lm_loss(
     cfg: ModelConfig,
     params: dict,
     tokens: jnp.ndarray,
     loss_mask: jnp.ndarray,
     remat: bool = True,
+    compute_dtype: str | None = None,
 ) -> jnp.ndarray:
     """Next-token cross-entropy. tokens [B, S]; loss_mask [B, S] with 1.0
-    on positions whose *prediction* (of the next token) counts."""
+    on positions whose *prediction* (of the next token) counts.
+
+    ``compute_dtype``: cast float params to this dtype for the forward
+    (mixed precision — the cast sits inside grad, so gradients flow back
+    to the original-dtype masters).
+    """
+    params = _cast_params(params, compute_dtype)
     logits = forward(cfg, params, tokens, remat=remat)  # [B, S, V] fp32
     targets = tokens[:, 1:]
     lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
@@ -98,7 +122,9 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig):
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, tokens, loss_mask):
         loss, grads = jax.value_and_grad(
-            lambda p: causal_lm_loss(cfg, p, tokens, loss_mask, tcfg.remat)
+            lambda p: causal_lm_loss(
+                cfg, p, tokens, loss_mask, tcfg.remat, tcfg.compute_dtype
+            )
         )(state.params)
         updates, opt_state = opt.update(
             grads, state.opt_state, state.params
@@ -123,7 +149,9 @@ def make_sharded_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
 
     def step(state: TrainState, tokens, loss_mask):
         loss, grads = jax.value_and_grad(
-            lambda p: causal_lm_loss(cfg, p, tokens, loss_mask, tcfg.remat)
+            lambda p: causal_lm_loss(
+                cfg, p, tokens, loss_mask, tcfg.remat, tcfg.compute_dtype
+            )
         )(state.params)
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

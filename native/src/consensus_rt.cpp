@@ -169,34 +169,46 @@ struct RtLoader {
   int64_t n_tokens = 0;
   int64_t batch = 0, seq = 0;
   std::mt19937_64 rng;
-  // Prefetch ring of ready batches.
-  std::deque<std::vector<int32_t>> ready;
+  // Prefetch ring of sampled window-start batches. Data is NOT copied
+  // here: the worker samples starts and madvise(WILLNEED)s the windows
+  // so pages fault in ahead of use; rt_loader_next does the single
+  // copy into the caller's buffer, and rt_loader_skip discards starts
+  // without ever touching token data.
+  std::deque<std::vector<int64_t>> ready;
   std::mutex mu;
   std::condition_variable cv_ready, cv_space;
   int64_t prefetch_depth = 4;
   std::thread worker;
   std::atomic<bool> stop{false};
 
-  void fill_one(std::vector<int32_t>& buf) {
+  std::vector<int64_t> sample_starts() {
     // Random contiguous windows — the standard LM pretraining sampler.
     std::uniform_int_distribution<int64_t> dist(0, n_tokens - seq - 1);
-    for (int64_t b = 0; b < batch; ++b) {
-      int64_t start = dist(rng);
-      std::memcpy(buf.data() + b * seq, tokens + start,
-                  sizeof(int32_t) * seq);
+    std::vector<int64_t> starts(batch);
+    for (int64_t b = 0; b < batch; ++b) starts[b] = dist(rng);
+    return starts;
+  }
+
+  void prefault(const std::vector<int64_t>& starts) {
+    long page = sysconf(_SC_PAGESIZE);
+    for (int64_t s : starts) {
+      auto addr = reinterpret_cast<uintptr_t>(tokens + s);
+      auto base = addr & ~(uintptr_t)(page - 1);
+      size_t len = (addr - base) + (size_t)seq * sizeof(int32_t);
+      madvise(reinterpret_cast<void*>(base), len, MADV_WILLNEED);
     }
   }
 
   void run() {
     while (!stop.load()) {
-      std::vector<int32_t> buf(batch * seq);
-      fill_one(buf);
+      auto starts = sample_starts();
+      prefault(starts);
       std::unique_lock<std::mutex> lk(mu);
       cv_space.wait(lk, [&] {
         return stop.load() || (int64_t)ready.size() < prefetch_depth;
       });
       if (stop.load()) return;
-      ready.emplace_back(std::move(buf));
+      ready.emplace_back(std::move(starts));
       cv_ready.notify_one();
     }
   }
@@ -227,23 +239,26 @@ void* rt_loader_create(const char* path, int64_t batch, int64_t seq,
   return l;
 }
 
-// Blocks until a [batch, seq] int32 batch is ready; copies it into out.
+// Blocks until a [batch, seq] start-set is ready; copies the windows
+// into out (the only data copy in the pipeline).
 int rt_loader_next(void* h, int32_t* out) {
   auto* l = static_cast<RtLoader*>(h);
   std::unique_lock<std::mutex> lk(l->mu);
   l->cv_ready.wait(lk, [&] { return l->stop.load() || !l->ready.empty(); });
   if (l->ready.empty()) return 1;
-  auto buf = std::move(l->ready.front());
+  auto starts = std::move(l->ready.front());
   l->ready.pop_front();
   l->cv_space.notify_one();
   lk.unlock();
-  std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+  for (int64_t b = 0; b < l->batch; ++b)
+    std::memcpy(out + b * l->seq, l->tokens + starts[b],
+                sizeof(int32_t) * l->seq);
   return 0;
 }
 
-// Consume and discard n batches (checkpoint-resume fast-forward): the
-// stream stays byte-identical to n rt_loader_next calls, without the
-// out-copy or a caller-side buffer per skipped batch.
+// Discard the next n batches (checkpoint-resume fast-forward). The
+// stream stays identical to n rt_loader_next calls, and since the ring
+// holds window starts — not data — no token bytes are touched.
 int rt_loader_skip(void* h, int64_t n) {
   auto* l = static_cast<RtLoader*>(h);
   for (int64_t i = 0; i < n; ++i) {

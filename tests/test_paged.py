@@ -170,6 +170,18 @@ def test_continuous_pool_exhaustion_recovers():
         b.close()
 
 
+def test_batcher_stats(batcher):
+    before = batcher.stats()
+    assert before["completed_requests"] == 0
+    assert before["total_pages"] == 63
+    batcher.submit("count me", max_new_tokens=4).result(timeout=120)
+    after = batcher.stats()
+    assert after["completed_requests"] == 1
+    assert after["generated_tokens"] >= 1
+    assert after["free_pages"] == before["free_pages"]  # pages returned
+    assert after["active_slots"] == 0
+
+
 def test_seed_reproducible_across_batch_states(batcher):
     """Same (prompt, seed, temperature) gives the same text whether it
     runs alone or alongside other requests."""

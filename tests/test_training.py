@@ -115,3 +115,30 @@ def test_sharded_train_step_moe_expert_parallel():
     state, loss = step(state, s_tokens, s_mask)
     assert np.isfinite(float(loss))
     assert int(state.step.addressable_shards[0].data) == 1
+
+
+def test_mixed_precision_compute_dtype():
+    """compute_dtype='bfloat16': fp32 masters stay fp32, loss is close
+    to the fp32 run, grads/updates land on the masters."""
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens, mask = _batch(cfg, b=4, s=16, seed=3)
+
+    tcfg32 = TrainConfig(warmup_steps=1, total_steps=10, remat=False)
+    tcfg16 = TrainConfig(
+        warmup_steps=1, total_steps=10, remat=False, compute_dtype="bfloat16"
+    )
+    def fresh():
+        return init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    step32 = make_train_step(cfg, tcfg32)
+    step16 = make_train_step(cfg, tcfg16)
+    s32, loss32 = step32(init_train_state(cfg, fresh(), tcfg32), tokens, mask)
+    s32, _ = step32(s32, tokens, mask)  # step 0 has warmup LR 0
+    s16, loss16 = step16(init_train_state(cfg, fresh(), tcfg16), tokens, mask)
+    s16, _ = step16(s16, tokens, mask)
+    assert abs(float(loss32) - float(loss16)) < 0.1
+    # Masters keep fp32 dtype and actually moved.
+    leaf = s16.params["blocks"]["wq"]
+    assert leaf.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(leaf - params["blocks"]["wq"]))) > 0
