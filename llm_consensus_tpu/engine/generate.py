@@ -123,7 +123,12 @@ def generate(
 
     def step(carry, i):
         tok, cache, done, lp_sum = carry
-        logits, cache = decode_step(cfg, params, tok[:, None], cache)
+        # Shared prefill => every row has the same fill length forever
+        # (all start equal, all advance by one each step), so the cache
+        # write can be a slice update instead of a scatter.
+        logits, cache = decode_step(
+            cfg, params, tok[:, None], cache, uniform_write=shared_prefill
+        )
         step_key = jax.random.fold_in(key, i + 1)
         next_tok, lp = sample_token(logits, step_key, temperature, sampler)
         next_tok = jnp.where(done, pad_id, next_tok)

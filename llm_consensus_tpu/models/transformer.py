@@ -269,12 +269,18 @@ def _block(
     mode: str,
     valid_len: jnp.ndarray | None,
     positions: jnp.ndarray | None,
+    uniform_write: bool = False,
 ):
     """One transformer block.
 
     ``kv_layer``: this layer's cache leaves — (k, v) for the bf16 cache,
     (k_q, v_q, k_scale, v_scale) for the int8 cache (head-major). Returns
     (x, new_kv_layer_tuple_or_None).
+
+    ``uniform_write`` (static): caller guarantees every row writes at
+    the SAME position (self-consistency fan-out after shared prefill) —
+    the decode cache write becomes one ``dynamic_update_slice`` instead
+    of a per-row scatter, which XLA:TPU serializes badly.
     """
     h = _rms(cfg, x, p["attn_norm"])
     q, k, v = _project_qkv(cfg, p, h)
@@ -309,22 +315,47 @@ def _block(
         # valid_len is the pre-write fill length; write the new token there.
         if len(kv_layer) == 2:
             k_l, v_l = kv_layer
-            new_k = k_l.at[batch_idx, valid_len].set(
-                k[:, 0].astype(k_l.dtype)
-            )
-            new_v = v_l.at[batch_idx, valid_len].set(
-                v[:, 0].astype(v_l.dtype)
-            )
+            if uniform_write:
+                pos0 = valid_len[0]
+                new_k = jax.lax.dynamic_update_slice(
+                    k_l, k.astype(k_l.dtype), (0, pos0, 0, 0)
+                )
+                new_v = jax.lax.dynamic_update_slice(
+                    v_l, v.astype(v_l.dtype), (0, pos0, 0, 0)
+                )
+            else:
+                new_k = k_l.at[batch_idx, valid_len].set(
+                    k[:, 0].astype(k_l.dtype)
+                )
+                new_v = v_l.at[batch_idx, valid_len].set(
+                    v[:, 0].astype(v_l.dtype)
+                )
             new_kv = (new_k, new_v)
             attn = _attn_decode(cfg, q, new_k, new_v, valid_len + 1)
         else:
             kq_l, vq_l, ks_l, vs_l = kv_layer
             kq1, ks1 = quantize_kv(k[:, 0])  # [B,Hkv,D] / [B,Hkv]
             vq1, vs1 = quantize_kv(v[:, 0])
-            new_kq = kq_l.at[batch_idx, :, valid_len].set(kq1)
-            new_vq = vq_l.at[batch_idx, :, valid_len].set(vq1)
-            new_ks = ks_l.at[batch_idx, :, valid_len].set(ks1)
-            new_vs = vs_l.at[batch_idx, :, valid_len].set(vs1)
+            if uniform_write:
+                pos0 = valid_len[0]
+                zero = jnp.zeros((), pos0.dtype)
+                new_kq = jax.lax.dynamic_update_slice(
+                    kq_l, kq1[:, :, None, :], (zero, zero, pos0, zero)
+                )
+                new_vq = jax.lax.dynamic_update_slice(
+                    vq_l, vq1[:, :, None, :], (zero, zero, pos0, zero)
+                )
+                new_ks = jax.lax.dynamic_update_slice(
+                    ks_l, ks1[:, :, None], (zero, zero, pos0)
+                )
+                new_vs = jax.lax.dynamic_update_slice(
+                    vs_l, vs1[:, :, None], (zero, zero, pos0)
+                )
+            else:
+                new_kq = kq_l.at[batch_idx, :, valid_len].set(kq1)
+                new_vq = vq_l.at[batch_idx, :, valid_len].set(vq1)
+                new_ks = ks_l.at[batch_idx, :, valid_len].set(ks1)
+                new_vs = vs_l.at[batch_idx, :, valid_len].set(vs1)
             new_kv = (new_kq, new_vq, new_ks, new_vs)
             attn = _attn_decode_quant(
                 cfg, q, new_kq, new_ks, new_vq, new_vs, valid_len + 1
@@ -349,6 +380,7 @@ def _run_layers(
     valid_len: jnp.ndarray | None,
     positions: jnp.ndarray | None,
     remat: bool = False,
+    uniform_write: bool = False,
 ):
     """lax.scan over the stacked layer axis."""
     blocks = params["blocks"]
@@ -372,7 +404,16 @@ def _run_layers(
     def body(carry, layer_in):
         p = layer_in[0]
         y, new_kv = _block(
-            cfg, p, carry, cos, sin, layer_in[1:], mode, valid_len, positions
+            cfg,
+            p,
+            carry,
+            cos,
+            sin,
+            layer_in[1:],
+            mode,
+            valid_len,
+            positions,
+            uniform_write=uniform_write,
         )
         return y, new_kv
 
@@ -519,11 +560,14 @@ def decode_step(
     params: dict,
     tokens: jnp.ndarray,
     cache: KVCache,
+    uniform_write: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """One decode step: tokens [B, 1] -> (logits [B, V] float32, new cache).
 
     The new token's k/v is written at slot ``cache.length`` and the fill
-    length advances by one.
+    length advances by one. ``uniform_write`` (static): all rows share
+    one fill length (shared-prefill fan-out) — the cache write compiles
+    to a slice update instead of a scatter.
     """
     x = params["embed"][tokens]  # [B, 1, D]
     positions = cache.length[:, None]  # [B, 1]
@@ -531,7 +575,16 @@ def decode_step(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
     x, cache = _run_layers(
-        cfg, params, x, cos, sin, cache, "decode", cache.length, None
+        cfg,
+        params,
+        x,
+        cos,
+        sin,
+        cache,
+        "decode",
+        cache.length,
+        None,
+        uniform_write=uniform_write,
     )
     logits = _unembed(cfg, params, x[:, 0])
     return logits, cache.advanced(1)
