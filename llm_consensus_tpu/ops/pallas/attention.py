@@ -147,12 +147,13 @@ def flash_causal_attention(
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
     """One (batch, kv-head) program.
 
-    len_ref: [1] SMEM valid length; q_ref: [1, 1, G, D];
-    k_ref/v_ref: [1, S, D]; o_ref: [1, 1, G, D].
+    len_ref: [B*Hkv] whole-array SMEM valid lengths (unblocked — Mosaic
+    rejects rank-1 blocked SMEM specs; index by program id instead);
+    q_ref: [1, 1, G, D]; k_ref/v_ref: [1, S, D]; o_ref: [1, 1, G, D].
     """
     _, _, g, d = q_ref.shape
     s = k_ref.shape[1]
-    valid = len_ref[0]
+    valid = len_ref[pl.program_id(0)]
 
     q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
     scores = jax.lax.dot_general(
@@ -182,22 +183,27 @@ def _decode_q8_kernel(
 ):
     """One (batch, kv-head) program over an int8 cache.
 
-    len_ref: [1] SMEM; q_ref: [1, 1, G, D]; kq_ref/vq_ref: [1, S, D] int8;
-    ks_ref/vs_ref: [1, S] f32; o_ref: [1, 1, G, D]. K/V dequantize
-    in-register — HBM reads stay int8 (+ one f32 scale per slot).
+    len_ref: [B*Hkv] whole-array SMEM (unblocked, indexed by program id);
+    q_ref: [1, 1, G, D]; kq_ref/vq_ref: [1, S, D] int8;
+    ks_ref/vs_ref: [1, 1, S] f32 (leading singleton keeps the block's
+    trailing dims equal to the array's — the Mosaic tiling rule);
+    o_ref: [1, 1, G, D]. K/V dequantize in-register — HBM reads stay
+    int8 (+ one f32 scale per slot).
     """
     _, _, g, d = q_ref.shape
     s = kq_ref.shape[1]
-    valid = len_ref[0]
+    valid = len_ref[pl.program_id(0)]
 
-    k = kq_ref[0].astype(jnp.float32) * ks_ref[0][:, None]  # [S, D]
+    # Dequant is linear: fold the per-slot scales into the [G, S]
+    # scores/probs instead of scaling the [S, D] K/V slabs (D-times
+    # fewer VPU ops; int8 slabs feed the MXU after a bare cast).
     q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
     scores = jax.lax.dot_general(
         q,
-        k,
+        kq_ref[0].astype(jnp.float32),
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale  # [G, S]
+    ) * (ks_ref[0] * scale)  # [G, S] * [1, S]
     slot = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
     scores = jnp.where(slot < valid, scores, _NEG_INF)
 
@@ -205,10 +211,9 @@ def _decode_q8_kernel(
     p = jnp.exp(scores - m)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
 
-    v = vq_ref[0].astype(jnp.float32) * vs_ref[0][:, None]  # [S, D]
     out = jax.lax.dot_general(
-        p,
-        v,
+        p * vs_ref[0],  # [G, S] * [1, S]
+        vq_ref[0].astype(jnp.float32),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [G, D]
@@ -243,8 +248,8 @@ def flash_decode_attention_q8(
     )
     kq2 = k_q.reshape(b * hkv, s, d)
     vq2 = v_q.reshape(b * hkv, s, d)
-    ks2 = k_scale.reshape(b * hkv, s)
-    vs2 = v_scale.reshape(b * hkv, s)
+    ks2 = k_scale.reshape(b * hkv, 1, s)
+    vs2 = v_scale.reshape(b * hkv, 1, s)
     lens = jnp.repeat(valid_len.astype(jnp.int32), hkv)
 
     out = pl.pallas_call(
@@ -252,18 +257,22 @@ def flash_decode_attention_q8(
         out_shape=jax.ShapeDtypeStruct((b * hkv, 1, g, d), q.dtype),
         grid=(b * hkv,),
         in_specs=[
-            pl.BlockSpec((1,), lambda bh: (bh,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(
                 (1, 1, g, d), lambda bh: (bh, 0, 0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
                 (1, s, d), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec((1, s), lambda bh: (bh, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, 1, s), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec(
                 (1, s, d), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec((1, s), lambda bh: (bh, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, 1, s), lambda bh: (bh, 0, 0), memory_space=pltpu.VMEM
+            ),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, g, d), lambda bh: (bh, 0, 0, 0), memory_space=pltpu.VMEM
@@ -307,7 +316,7 @@ def flash_decode_attention(
         out_shape=jax.ShapeDtypeStruct((b * hkv, 1, g, d), q.dtype),
         grid=(b * hkv,),
         in_specs=[
-            pl.BlockSpec((1,), lambda bh: (bh,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(
                 (1, 1, g, d), lambda bh: (bh, 0, 0, 0), memory_space=pltpu.VMEM
             ),

@@ -19,7 +19,8 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
     x = x_ref[:].astype(jnp.float32)  # [blk, D]
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
     inv = jax.lax.rsqrt(ms + eps)
-    o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    w = w_ref[:].astype(jnp.float32)  # [1, D] (2-D: Mosaic rejects rank-1 blocks)
+    o_ref[:] = (x * inv * w).astype(o_ref.dtype)
 
 
 def fused_rms_norm(
@@ -47,13 +48,13 @@ def fused_rms_norm(
         grid=(x2.shape[0] // blk,),
         in_specs=[
             pl.BlockSpec((blk, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((d,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             (blk, d), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         interpret=interpret,
-    )(x2, weight)
+    )(x2, weight.reshape(1, d))
     if pad:
         out = out[:n]
     return out.reshape(orig_shape)

@@ -4,10 +4,9 @@ Why a kernel: XLA:TPU dots read *materialized* operand buffers, so the
 weight-only int8 path (``x @ dequantize(w)``) round-trips a bf16 copy of
 the weights through HBM — and inside the token-decode ``lax.scan`` XLA
 hoists the loop-invariant dequant entirely, making int8 decode no faster
-than bf16 (measured: 16.1k vs 15.4k tok/s/chip on llama-1b N=64). This
-kernel loads int8 tiles straight into VMEM, converts in-register, and
-feeds the MXU — per decode step the weights cost half the HBM traffic of
-bf16, which is the whole point of
+than bf16. This kernel loads int8 tiles straight into VMEM, converts
+in-register, and feeds the MXU — per decode step the weights cost half
+the HBM traffic of bf16, which is the whole point of
 :mod:`llm_consensus_tpu.ops.quant`.
 
 Scope: the M dimension (batch rows) must be small enough that ``x`` fits
