@@ -57,7 +57,14 @@ class EngineResult:
 
 
 class InferenceEngine:
-    """Batched local text generation on one model's weights."""
+    """Batched local text generation on one model's weights.
+
+    Pass ``mesh`` to run sharded (BASELINE.json north star): params are
+    placed per :func:`llm_consensus_tpu.parallel.partitioning.param_pspecs`
+    (TP over ``model``, EP over ``expert``, replicated over ``data``) and
+    every batch shards its candidate axis over ``data`` — the N-way
+    fan-out becomes one GSPMD program whose KV cache lives sharded in HBM.
+    """
 
     def __init__(
         self,
@@ -65,6 +72,7 @@ class InferenceEngine:
         params: dict,
         tokenizer: Tokenizer | None = None,
         engine_config: EngineConfig | None = None,
+        mesh=None,
     ):
         self.cfg = cfg
         self.params = params
@@ -81,6 +89,25 @@ class InferenceEngine:
             self.params = quantize_params(self.params)
         elif self.config.quant != "none":
             raise ValueError(f"unknown quant mode {self.config.quant!r}")
+        self.mesh = mesh
+        self._data_sharding = None
+        if mesh is not None:
+            from dataclasses import replace
+
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from llm_consensus_tpu.parallel.partitioning import shard_params
+
+            self.params = shard_params(self.params, mesh)
+            self._data_sharding = NamedSharding(mesh, P("data"))
+            # Batch buckets must tile the data axis evenly.
+            dp = int(mesh.shape.get("data", 1))
+            if dp > 1:
+                bb = tuple(
+                    b for b in self.config.batch_buckets if b % dp == 0
+                ) or (dp,)
+                self.config = replace(self.config, batch_buckets=bb)
 
     # ------------------------------------------------------------------
 
@@ -175,19 +202,31 @@ class InferenceEngine:
         # Identical prompts (self-consistency fan-out) prefill once and
         # broadcast the cache instead of prefetching B copies.
         shared = n_real == b and len(set(prompts)) == 1 and b > 1
+        tokens_j, lengths_j, temps_j = (
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(temps),
+        )
+        if self._data_sharding is not None:
+            tokens_j = jax.device_put(tokens_j, self._data_sharding)
+            lengths_j = jax.device_put(lengths_j, self._data_sharding)
+            temps_j = jax.device_put(temps_j, self._data_sharding)
         out: GenerateOutput = generate(
             self.cfg,
             self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(lengths),
+            tokens_j,
+            lengths_j,
             jax.random.PRNGKey(seed),
-            jnp.asarray(temps),
+            temps_j,
             max_new_tokens=mnt,
             sampler=sampler if sampler is not None else self.config.sampler,
             eos_id=self.tokenizer.eos_id,
             pad_id=self.tokenizer.pad_id,
             shared_prefill=shared,
             kv_quant=self.config.kv_quant,
+            # Ring prefill (long-context sequence parallelism) when the
+            # model opts in and the mesh has a seq axis.
+            mesh=self.mesh if self.cfg.use_ring else None,
         )
         toks = np.asarray(out.tokens)
         nums = np.asarray(out.num_tokens)

@@ -68,6 +68,7 @@ class GenerateOutput:
         "cache_len",
         "shared_prefill",
         "kv_quant",
+        "mesh",  # hashable; trace-time constant for the ring routing
     ),
 )
 def generate(
@@ -85,6 +86,7 @@ def generate(
     cache_len: int | None = None,
     shared_prefill: bool = False,
     kv_quant: bool = False,
+    mesh=None,
 ) -> GenerateOutput:
     """Generate up to ``max_new_tokens`` for a batch of right-padded prompts.
 
@@ -107,13 +109,13 @@ def generate(
         # (B-1)/B of the prefill FLOPs (BASELINE.json's N-way configs).
         cache1 = make_cache(cfg, 1, cache_len)
         logits1, cache1 = prefill(
-            cfg, params, tokens[:1], lengths[:1], cache1
+            cfg, params, tokens[:1], lengths[:1], cache1, mesh=mesh
         )
         logits = jnp.broadcast_to(logits1, (b, logits1.shape[-1]))
         cache = _broadcast_cache(cache1, b)
     else:
         cache = make_cache(cfg, b, cache_len)
-        logits, cache = prefill(cfg, params, tokens, lengths, cache)
+        logits, cache = prefill(cfg, params, tokens, lengths, cache, mesh=mesh)
 
     key0 = jax.random.fold_in(key, 0)
     tok0, lp0 = sample_token(logits, key0, temperature, sampler)

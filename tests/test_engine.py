@@ -279,3 +279,61 @@ def test_engine_rejects_small_vocab():
     cfg = get_config("test-tiny").with_(vocab_size=16)
     with pytest.raises(ValueError):
         InferenceEngine(cfg, params={}, tokenizer=ByteTokenizer())
+
+
+# ---------------------------------------------------------------------------
+# Mesh-wired engine (VERDICT r2 #4: the N-way fan-out as one sharded program)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_mesh_sharded_self_consistency_matches_single_device(tiny):
+    """self_consistency(n=16) on a dp=8 mesh: params replicated over
+    `data`, candidate batch + KV cache sharded — tokens must match the
+    unsharded engine exactly (same program, GSPMD-partitioned)."""
+    from jax.sharding import PartitionSpec as P
+
+    from llm_consensus_tpu.consensus.voting import self_consistency
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg, params = tiny
+    ecfg = EngineConfig(
+        max_new_tokens=6, seq_buckets=(16,), batch_buckets=(1, 2, 4, 8, 16)
+    )
+    mesh = make_mesh(MeshConfig(data=8))
+    single = InferenceEngine(cfg, params, engine_config=ecfg)
+    sharded = InferenceEngine(cfg, params, engine_config=ecfg, mesh=mesh)
+
+    # Params actually landed sharded (replicated spec over the mesh).
+    wq = sharded.params["blocks"]["wq"]
+    assert wq.sharding.mesh.shape["data"] == 8
+    assert wq.sharding.spec == P(None, None, "model")
+
+    r_single = self_consistency(
+        single, "What is 2+2?", n=16, temperature=0.8, seed=3
+    )
+    r_sharded = self_consistency(
+        sharded, "What is 2+2?", n=16, temperature=0.8, seed=3
+    )
+    assert r_sharded.candidates == r_single.candidates
+    assert r_sharded.vote.winner == r_single.vote.winner
+
+
+def test_engine_mesh_batch_buckets_respect_data_axis(tiny):
+    """A dp=8 mesh drops batch buckets that don't tile the data axis."""
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg, params = tiny
+    mesh = make_mesh(MeshConfig(data=8))
+    eng = InferenceEngine(
+        cfg,
+        params,
+        engine_config=EngineConfig(
+            max_new_tokens=3, seq_buckets=(16,), batch_buckets=(1, 2, 4, 8, 16)
+        ),
+        mesh=mesh,
+    )
+    assert eng.config.batch_buckets == (8, 16)
+    # A 3-prompt call pads up to the 8-bucket and still returns 3 results.
+    results = eng.generate_texts(["a", "bb", "ccc"])
+    assert len(results) == 3
+    assert all(r.num_tokens >= 1 for r in results)

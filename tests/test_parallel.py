@@ -156,9 +156,87 @@ def test_data_parallel_generate_across_mesh():
     )
     sharded_tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
     sharded_lengths = jax.device_put(lengths, NamedSharding(mesh, P("data")))
-    replicated = shard_params(params, make_mesh(MeshConfig(data=8)))
+    replicated = shard_params(params, mesh)
     out = generate(
-        cfg, params, sharded_tokens, sharded_lengths, jax.random.PRNGKey(0),
-        jnp.zeros(b), max_new_tokens=4,
+        cfg, replicated, sharded_tokens, sharded_lengths,
+        jax.random.PRNGKey(0), jnp.zeros(b), max_new_tokens=4,
     )
     assert out.tokens.tolist() == baseline.tokens.tolist()
+
+
+# ---------------------------------------------------------------------------
+# Ring attention integrated into the model (VERDICT r2 #7)
+# ---------------------------------------------------------------------------
+
+
+def test_forward_with_ring_matches_dense_forward():
+    """cfg.use_ring + a seq-sharded mesh routes model attention through
+    the ring; logits must match the plain dense forward."""
+    from llm_consensus_tpu.models.transformer import forward
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+
+    want = forward(cfg, params, tokens)
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    got = forward(cfg.with_(use_ring=True), params, tokens, mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_engine_ring_prefill_matches_dense_generate():
+    """Greedy generate with ring prefill over a seq-sharded mesh equals
+    single-device generate token-for-token (long-context path live)."""
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ecfg = EngineConfig(
+        max_new_tokens=5, seq_buckets=(32,), batch_buckets=(1, 2)
+    )
+    plain = InferenceEngine(cfg, params, engine_config=ecfg)
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    ring = InferenceEngine(
+        cfg.with_(use_ring=True), params, engine_config=ecfg, mesh=mesh
+    )
+    prompts = ["the quick brown fox jumps", "pack my box"]
+    want = [r.text for r in plain.generate_texts(prompts)]
+    got = [r.text for r in ring.generate_texts(prompts)]
+    assert got == want
+
+
+def test_sharded_train_step_with_ring_matches_dense():
+    """One sp-sharded train step with use_ring: loss equals the plain
+    unsharded step's loss (same data, same init)."""
+    from llm_consensus_tpu.training.train import (
+        TrainConfig,
+        init_train_state,
+        make_sharded_train_step,
+        make_train_step,
+    )
+
+    cfg = get_config("test-tiny")
+    tcfg = TrainConfig(warmup_steps=1, total_steps=4, remat=False)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0, 256)
+    mask = jnp.ones((4, 32), jnp.float32)
+
+    # Separate identically-initialized trees: the train steps donate
+    # their state, deleting the first leg's buffers.
+    params0 = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    step0 = make_train_step(cfg, tcfg)
+    _, loss_plain = step0(
+        init_train_state(cfg, params0, tcfg), tokens, mask
+    )
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    rcfg = cfg.with_(use_ring=True)
+    params = init_params(rcfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    step1, place = make_sharded_train_step(rcfg, tcfg, mesh)
+    state = init_train_state(rcfg, params, tcfg)
+    state, s_tokens, s_mask = place(state, tokens, mask)
+    _, loss_ring = step1(state, s_tokens, s_mask)
+    np.testing.assert_allclose(
+        float(loss_ring), float(loss_plain), rtol=1e-5
+    )
