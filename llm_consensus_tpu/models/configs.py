@@ -52,6 +52,10 @@ class ModelConfig:
     # Use the fused Pallas kernels (ops/pallas) for attention + RMSNorm on
     # the hot path; False = pure-XLA jnp reference ops.
     use_pallas: bool = False
+    # Route full/prefill attention through ring attention
+    # (parallel/ring.py) when a mesh with seq > 1 is passed to
+    # forward/prefill — sequence-parallel long-context support.
+    use_ring: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -113,6 +117,9 @@ PRESETS: dict[str, ModelConfig] = {
         rope_theta=1000000.0,
         n_experts=8,
         n_experts_per_token=2,
+        # Capacity-bounded dispatch by default: the dense all-experts
+        # path would spend E/k = 4x the needed FLOPs at this scale.
+        moe_capacity_factor=1.25,
         max_seq_len=8192,
     ),
     # ~1.1B dense config for single-chip benchmarking (fits v5e HBM in bf16

@@ -51,7 +51,21 @@ def _rms(cfg: ModelConfig, x, w):
     return rms_norm(x, w, cfg.rms_norm_eps)
 
 
-def _attn_causal(cfg: ModelConfig, q, k, v, positions):
+def _attn_causal(cfg: ModelConfig, q, k, v, positions, mesh=None):
+    # Sequence-parallel long context: the ring (parallel/ring.py) handles
+    # index-causal layouts over a seq-sharded mesh; K/V chunks rotate on
+    # ICI instead of any device holding the full sequence.
+    if (
+        cfg.use_ring
+        and mesh is not None
+        and positions is None
+        and cfg.sliding_window == 0
+        and mesh.shape.get("seq", 1) > 1
+        and q.shape[1] % mesh.shape["seq"] == 0
+    ):
+        from llm_consensus_tpu.parallel.ring import ring_attention_sharded
+
+        return ring_attention_sharded(q, k, v, mesh)
     # The fused kernel implements index-causal masking; packed/offset
     # layouts (explicit positions) and sliding windows use the jnp path.
     if (
@@ -84,13 +98,13 @@ def _attn_decode(cfg: ModelConfig, q, k_cache, v_cache, valid_len):
 
 
 def _attn_decode_quant(cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len):
-    """int8-cache decode attention: Pallas on single-chip TPU (the whole
-    point of the quantized cache is reading int8 from HBM), jnp dequant
-    elsewhere — pallas_call is opaque to GSPMD, so sharded meshes must
-    take the shardable jnp path (same rule as ops.quant._use_kernel)."""
-    use_kernel = (
-        cfg.use_pallas or jax.default_backend() == "tpu"
-    ) and jax.device_count() == 1
+    """int8-cache decode attention: the Pallas kernel reads int8 straight
+    from HBM (the whole point of the quantized cache) but pallas_call is
+    opaque to GSPMD, so it is strictly opt-in via ``cfg.use_pallas`` and
+    single-device; sharded meshes take the shardable jnp dequant path.
+    (ops.quant._use_kernel auto-detects instead — its off-switch is
+    ``ops.quant.set_kernel_enabled(False)``.)"""
+    use_kernel = cfg.use_pallas and jax.device_count() == 1
     if use_kernel and cfg.sliding_window == 0:
         from llm_consensus_tpu.ops.pallas import flash_decode_attention_q8
 
@@ -270,6 +284,7 @@ def _block(
     valid_len: jnp.ndarray | None,
     positions: jnp.ndarray | None,
     uniform_write: bool = False,
+    mesh=None,
 ):
     """One transformer block.
 
@@ -288,10 +303,10 @@ def _block(
     k = apply_rope(k, cos, sin)
 
     if mode == "full":
-        attn = _attn_causal(cfg, q, k, v, positions)
+        attn = _attn_causal(cfg, q, k, v, positions, mesh=mesh)
         new_kv = None
     elif mode == "prefill":
-        attn = _attn_causal(cfg, q, k, v, positions)
+        attn = _attn_causal(cfg, q, k, v, positions, mesh=mesh)
         s = k.shape[1]
         if len(kv_layer) == 2:
             k_l, v_l = kv_layer
@@ -381,6 +396,7 @@ def _run_layers(
     positions: jnp.ndarray | None,
     remat: bool = False,
     uniform_write: bool = False,
+    mesh=None,
 ):
     """lax.scan over the stacked layer axis."""
     blocks = params["blocks"]
@@ -388,7 +404,10 @@ def _run_layers(
     if mode == "full":
 
         def body(carry, p):
-            y, _ = _block(cfg, p, carry, cos, sin, None, "full", None, positions)
+            y, _ = _block(
+                cfg, p, carry, cos, sin, None, "full", None, positions,
+                mesh=mesh,
+            )
             return y, None
 
         if remat:
@@ -414,6 +433,7 @@ def _run_layers(
             valid_len,
             positions,
             uniform_write=uniform_write,
+            mesh=mesh,
         )
         return y, new_kv
 
@@ -448,8 +468,14 @@ def forward(
     tokens: jnp.ndarray,
     positions: jnp.ndarray | None = None,
     remat: bool = False,
+    mesh=None,
 ) -> jnp.ndarray:
-    """Full causal forward: tokens [B, S] -> logits [B, S, V] (float32)."""
+    """Full causal forward: tokens [B, S] -> logits [B, S, V] (float32).
+
+    ``mesh``: pass a mesh with ``seq > 1`` (and ``cfg.use_ring``) to run
+    attention as sequence-parallel ring attention — the long-context
+    path; trace-time constant, so it composes with jit.
+    """
     x = params["embed"][tokens]
     if positions is None:
         positions_arr = jnp.broadcast_to(
@@ -461,7 +487,8 @@ def forward(
         positions_arr, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
     x, _ = _run_layers(
-        cfg, params, x, cos, sin, None, "full", None, positions, remat=remat
+        cfg, params, x, cos, sin, None, "full", None, positions,
+        remat=remat, mesh=mesh,
     )
     return _unembed(cfg, params, x)
 
@@ -472,6 +499,7 @@ def prefill(
     tokens: jnp.ndarray,
     lengths: jnp.ndarray,
     cache: KVCache,
+    mesh=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Prefill right-padded prompts.
 
@@ -490,7 +518,7 @@ def prefill(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
     x, cache = _run_layers(
-        cfg, params, x, cos, sin, cache, "prefill", None, None
+        cfg, params, x, cos, sin, cache, "prefill", None, None, mesh=mesh
     )
     # Gather hidden state at the last real token of each sequence.
     b = tokens.shape[0]

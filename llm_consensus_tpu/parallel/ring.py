@@ -33,12 +33,16 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     axis_size: int,
+    varying_axes: tuple[str, ...] | None = None,
 ) -> jnp.ndarray:
     """Causal ring attention over one sequence-sharded axis.
 
     Call from inside ``shard_map``/``pjit`` with ``axis_name`` mapped.
     q: [B, S_loc, H, D]; k/v: [B, S_loc, Hkv, D] (GQA: H = Hkv * G).
     ``axis_size`` is the static number of ring participants.
+    ``varying_axes``: every manual mesh axis the inputs are sharded over
+    (the scan-carry accumulators must be marked varying over all of
+    them); defaults to just the ring axis.
     Returns [B, S_loc, H, D] in q's dtype.
     """
     b, s, h, d = q.shape
@@ -53,7 +57,7 @@ def ring_attention(
     # The accumulators are per-shard state, varying over the ring axis —
     # mark them so the scan carry type matches its updated value.
     def _varying(x):
-        return jax.lax.pcast(x, axis_name, to="varying")
+        return jax.lax.pcast(x, varying_axes or (axis_name,), to="varying")
 
     m0 = _varying(jnp.full((b, hkv, g, s), _NEG_INF, jnp.float32))
     l0 = _varying(jnp.zeros((b, hkv, g, s), jnp.float32))
@@ -112,16 +116,39 @@ def ring_attention_sharded(
     """Convenience wrapper: shard q/k/v over ``axis_name`` and run the ring.
 
     q/k/v: full [B, S, H|Hkv, D] arrays; S must divide evenly by the axis
-    size. Batch stays on ``data`` if that axis exists in the mesh.
+    size. The batch axis shards over ``data`` and heads over ``model``
+    when those mesh axes exist and divide evenly — so the ring composes
+    with dp/tp instead of forcing a reshard at its boundary.
     """
     axis_size = mesh.shape[axis_name]
     if q.shape[1] % axis_size:
         raise ValueError(
             f"sequence {q.shape[1]} not divisible by {axis_name}={axis_size}"
         )
-    spec = P(None, axis_name, None, None)
+    b, _, h, _ = q.shape
+    hkv = k.shape[2]
+    batch_ax = None
+    if "data" in mesh.axis_names and b % mesh.shape["data"] == 0:
+        batch_ax = "data"
+    head_ax = None
+    if (
+        "model" in mesh.axis_names
+        and h % mesh.shape["model"] == 0
+        and hkv % mesh.shape["model"] == 0
+        # per-shard GQA grouping must stay integral
+        and (h // mesh.shape["model"]) % max(hkv // mesh.shape["model"], 1)
+        == 0
+    ):
+        head_ax = "model"
+    spec = P(batch_ax, axis_name, head_ax, None)
+    varying = tuple(a for a in (batch_ax, axis_name, head_ax) if a)
     fn = jax.shard_map(
-        partial(ring_attention, axis_name=axis_name, axis_size=axis_size),
+        partial(
+            ring_attention,
+            axis_name=axis_name,
+            axis_size=axis_size,
+            varying_axes=varying,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

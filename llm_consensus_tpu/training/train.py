@@ -87,16 +87,19 @@ def causal_lm_loss(
     loss_mask: jnp.ndarray,
     remat: bool = True,
     compute_dtype: str | None = None,
+    mesh=None,
 ) -> jnp.ndarray:
     """Next-token cross-entropy. tokens [B, S]; loss_mask [B, S] with 1.0
     on positions whose *prediction* (of the next token) counts.
 
     ``compute_dtype``: cast float params to this dtype for the forward
     (mixed precision — the cast sits inside grad, so gradients flow back
-    to the original-dtype masters).
+    to the original-dtype masters). ``mesh`` routes attention through
+    ring attention when ``cfg.use_ring`` and the mesh has ``seq > 1`` —
+    true sequence parallelism, not just activation sharding.
     """
     params = _cast_params(params, compute_dtype)
-    logits = forward(cfg, params, tokens, remat=remat)  # [B, S, V] fp32
+    logits = forward(cfg, params, tokens, remat=remat, mesh=mesh)
     targets = tokens[:, 1:]
     lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
@@ -150,7 +153,13 @@ def make_sharded_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     def step(state: TrainState, tokens, loss_mask):
         loss, grads = jax.value_and_grad(
             lambda p: causal_lm_loss(
-                cfg, p, tokens, loss_mask, tcfg.remat, tcfg.compute_dtype
+                cfg,
+                p,
+                tokens,
+                loss_mask,
+                tcfg.remat,
+                tcfg.compute_dtype,
+                mesh=mesh if cfg.use_ring else None,
             )
         )(state.params)
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
