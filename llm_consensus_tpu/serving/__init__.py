@@ -13,8 +13,10 @@ panel fan-outs) enqueue requests; two granularities are offered:
 """
 
 from llm_consensus_tpu.serving.continuous import (
+    ContinuousBackend,
     ContinuousBatcher,
     ContinuousConfig,
+    ServeResult,
 )
 from llm_consensus_tpu.serving.scheduler import (
     BatchScheduler,
@@ -24,8 +26,10 @@ from llm_consensus_tpu.serving.scheduler import (
 
 __all__ = [
     "BatchScheduler",
+    "ContinuousBackend",
     "ContinuousBatcher",
     "ContinuousConfig",
     "SchedulerConfig",
+    "ServeResult",
     "ServingBackend",
 ]

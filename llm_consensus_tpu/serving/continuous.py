@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llm_consensus_tpu.backends import base as _backend_base
 from llm_consensus_tpu.engine.engine import _next_bucket
 from llm_consensus_tpu.engine.sampler import (
     SamplerConfig,
@@ -69,6 +70,17 @@ class ContinuousConfig:
     seq_buckets: tuple[int, ...] = (64, 128, 256, 512)
     sampler: SamplerConfig | None = None
     poll_interval_s: float = 0.001
+    # Over-long prompts: left-truncate to the largest bucket (keeping the
+    # question tail) with a warning, or reject when False.
+    truncate_prompts: bool = True
+
+
+@dataclass
+class ServeResult:
+    """What a :meth:`ContinuousBatcher.submit` future resolves to."""
+
+    text: str
+    num_tokens: int  # generated tokens incl. EOS
 
 
 @dataclass
@@ -170,7 +182,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         seed: int = 0,
     ) -> Future:
-        """Enqueue a request; Future resolves to the generated text."""
+        """Enqueue a request; Future resolves to a :class:`ServeResult`."""
         if self._stop.is_set():
             raise RuntimeError("batcher stopped")
         c = self.config
@@ -178,9 +190,21 @@ class ContinuousBatcher:
             max_new_tokens = c.max_new_tokens
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens must be > 0, got {max_new_tokens}")
-        ids = np.asarray(
-            self.tokenizer.encode(prompt)[- (c.seq_buckets[-1]) :], np.int32
-        )
+        full_ids = self.tokenizer.encode(prompt)
+        cap = c.seq_buckets[-1]
+        if len(full_ids) > cap:
+            if not c.truncate_prompts:
+                raise ValueError(
+                    f"prompt is {len(full_ids)} tokens but the largest "
+                    f"sequence bucket is {cap} (set truncate_prompts=True "
+                    "to left-truncate instead)"
+                )
+            log.warning(
+                "prompt of %d tokens left-truncated to %d (largest bucket)",
+                len(full_ids),
+                cap,
+            )
+        ids = np.asarray(full_ids[-cap:], np.int32)
         req = _Request(
             prompt_ids=ids,
             max_new_tokens=max_new_tokens,
@@ -314,7 +338,12 @@ class ContinuousBatcher:
             t for t in slot.generated if t != self.tokenizer.eos_id
         ]
         if not slot.request.future.done():
-            slot.request.future.set_result(self.tokenizer.decode(ids))
+            slot.request.future.set_result(
+                ServeResult(
+                    text=self.tokenizer.decode(ids),
+                    num_tokens=len(slot.generated),
+                )
+            )
 
     def _step(self) -> None:
         c = self.config
@@ -355,3 +384,60 @@ class ContinuousBatcher:
             else:
                 self._work.wait(timeout=0.1)
                 self._work.clear()
+
+
+class ContinuousBackend(_backend_base.Backend):
+    """Backend seam over a :class:`ContinuousBatcher`.
+
+    The Coordinator's panel fan-out (``generate_batch``) rides token-level
+    continuous batching: each request joins the running decode batch at
+    step granularity instead of waiting for a whole-batch program. This
+    closes the reference's L1 seam (``call_gemini``, src/main.rs:82-86)
+    over the throughput-serving path.
+    """
+
+    def __init__(self, batcher: ContinuousBatcher):
+        self.batcher = batcher
+
+    async def generate_batch(self, requests):
+        import asyncio
+
+        BackendError = _backend_base.BackendError
+        GenerationResult = _backend_base.GenerationResult
+
+        # Validate the WHOLE batch before submitting anything: raising
+        # mid-loop would abandon already-enqueued requests (device steps
+        # burned for futures nobody collects).
+        for r in requests:
+            if r.params.top_k or r.params.top_p != 1.0:
+                raise BackendError(
+                    "ContinuousBatcher applies its config-level sampler; "
+                    "per-request top_k/top_p are not supported"
+                )
+        futs = []
+        try:
+            for r in requests:
+                futs.append(
+                    self.batcher.submit(
+                        r.prompt,
+                        max_new_tokens=r.params.max_new_tokens,
+                        temperature=r.params.temperature,
+                        seed=r.params.seed,
+                    )
+                )
+        except (RuntimeError, ValueError) as e:
+            # A mid-batch submit failure (stopped batcher, rejected
+            # prompt) leaves earlier futures in flight: cancel the ones
+            # still waiting so their device work isn't silently orphaned
+            # (_admit/_retire skip done futures).
+            for f in futs:
+                f.cancel()
+            raise BackendError(f"continuous submit failed: {e}") from e
+        outs = await asyncio.gather(*(asyncio.wrap_future(f) for f in futs))
+        return [
+            GenerationResult(text=o.text, num_tokens=o.num_tokens)
+            for o in outs
+        ]
+
+    async def close(self) -> None:
+        self.batcher.close()

@@ -132,7 +132,7 @@ def test_continuous_matches_engine_greedy(batcher):
     for p in prompts:
         futures.append(batcher.submit(p, max_new_tokens=8))
         time.sleep(0.02)  # arrive mid-flight
-    got = [f.result(timeout=120) for f in futures]
+    got = [f.result(timeout=120).text for f in futures]
 
     eng = InferenceEngine(
         CFG,
@@ -163,9 +163,9 @@ def test_continuous_pool_exhaustion_recovers():
     )
     try:
         futures = [b.submit(f"q{i}", max_new_tokens=4) for i in range(5)]
-        texts = [f.result(timeout=120) for f in futures]
-        assert len(texts) == 5
-        assert all(isinstance(t, str) for t in texts)
+        outs = [f.result(timeout=120) for f in futures]
+        assert len(outs) == 5
+        assert all(isinstance(o.text, str) and o.num_tokens >= 1 for o in outs)
     finally:
         b.close()
 
@@ -242,5 +242,113 @@ def test_oversized_request_rejected():
     try:
         with pytest.raises(ValueError, match="pages"):
             b.submit("hi", max_new_tokens=64).result(timeout=60)
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# ContinuousBackend: the consensus protocol over token-level batching
+# (VERDICT r2 #8 — serving exposed through the Backend seam)
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_backend_generate_batch(batcher):
+    """generate_batch over the batcher returns per-request results."""
+    import asyncio
+
+    from llm_consensus_tpu.backends.base import (
+        Backend,
+        GenerationRequest,
+        SamplingParams,
+    )
+    from llm_consensus_tpu.serving.continuous import ContinuousBackend
+
+    backend = ContinuousBackend(batcher)
+    assert isinstance(backend, Backend)
+    reqs = [
+        GenerationRequest(
+            prompt=p, params=SamplingParams(max_new_tokens=6)
+        )
+        for p in ["one", "two", "three"]
+    ]
+    results = asyncio.run(backend.generate_batch(reqs))
+    assert len(results) == 3
+    assert all(r.num_tokens >= 1 for r in results)
+
+
+def test_continuous_backend_rejects_per_request_topk(batcher):
+    import asyncio
+
+    from llm_consensus_tpu.backends.base import (
+        BackendError,
+        GenerationRequest,
+        SamplingParams,
+    )
+    from llm_consensus_tpu.serving.continuous import ContinuousBackend
+
+    backend = ContinuousBackend(batcher)
+    with pytest.raises(BackendError, match="top_k"):
+        asyncio.run(
+            backend.generate_batch(
+                [
+                    GenerationRequest(
+                        prompt="x", params=SamplingParams(top_k=5)
+                    )
+                ]
+            )
+        )
+
+
+def test_coordinator_protocol_over_continuous_backend(batcher):
+    """The full consensus protocol rides token-level batching: panel
+    fan-outs arrive as generate_batch lists and interleave at decode-step
+    granularity. A random-weight tiny model never produces parseable
+    verdicts, so every round dissents and the round cap terminates —
+    exercising propose -> evaluate -> refine end to end."""
+    import asyncio
+
+    from llm_consensus_tpu.backends.base import SamplingParams
+    from llm_consensus_tpu.consensus.coordinator import (
+        Coordinator,
+        CoordinatorConfig,
+    )
+    from llm_consensus_tpu.consensus.personas import default_panel
+    from llm_consensus_tpu.serving.continuous import ContinuousBackend
+
+    backend = ContinuousBackend(batcher)
+    coord = Coordinator(
+        panel=default_panel(),
+        backend=backend,
+        config=CoordinatorConfig(
+            max_rounds=2,
+            seed=0,
+            sampling=SamplingParams(max_new_tokens=4),
+        ),
+    )
+    res = asyncio.run(coord.run("What is 2+2?"))
+    assert res.answer  # some text was produced
+    assert res.rounds <= 2
+    assert res.endorsed is False  # garbage verdicts parse as dissent
+
+
+def test_overlong_prompt_rejected_when_truncation_disabled():
+    """truncate_prompts=False surfaces over-long prompts instead of
+    silently dropping their head (ADVICE r1)."""
+    b = ContinuousBatcher(
+        CFG,
+        _params(),
+        config=ContinuousConfig(
+            max_slots=2,
+            page_size=16,
+            n_pages=32,
+            pages_per_seq=8,
+            max_new_tokens=4,
+            seq_buckets=(16,),
+            truncate_prompts=False,
+        ),
+    )
+    try:
+        with pytest.raises(ValueError, match="bucket"):
+            b.submit("x" * 100)  # ~100 byte tokens > 16-token bucket
     finally:
         b.close()
