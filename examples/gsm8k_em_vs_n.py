@@ -1,0 +1,52 @@
+"""Record the EM-vs-N self-consistency table on the bundled dataset.
+
+Runs evaluate_self_consistency at N in {1, 3, 5, 9, 17} over
+eval/data/gsm8k_mini.jsonl with the deterministic noisy-oracle candidate
+stream (``--p`` per-candidate accuracy, default 0.6) so the table in
+eval/EM_VS_N.md documents the *voting* effect reproducibly offline. For
+model-accuracy numbers, call ``evaluate_self_consistency`` with a real
+``InferenceEngine`` (weights via ``models/hf_loader.py``) instead of the
+oracle — same harness, same report.
+
+Usage: python examples/gsm8k_em_vs_n.py [--p 0.6] [--ns 1 3 5 9 17]
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from llm_consensus_tpu.eval.gsm8k import (
+    OracleEngine,
+    evaluate_self_consistency,
+    load_gsm8k,
+)
+
+DATA = (
+    Path(__file__).parent.parent
+    / "llm_consensus_tpu/eval/data/gsm8k_mini.jsonl"
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--p", type=float, default=0.6)
+    ap.add_argument("--ns", type=int, nargs="+", default=[1, 3, 5, 9, 17])
+    args = ap.parse_args()
+
+    problems = load_gsm8k(DATA)
+    rows = []
+    for n in args.ns:
+        engine = OracleEngine(problems, args.p)
+        rep = evaluate_self_consistency(
+            engine, problems, n=n, temperature=0.7, seed=0
+        )
+        rows.append((n, rep.em))
+        print(json.dumps({"n": n, "em": rep.em}))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -168,3 +168,50 @@ def _answer_key(text: str) -> str:
     unless they truly dominate."""
     num = extract_final_number(text)
     return num if num is not None else _NO_ANSWER
+
+
+class OracleEngine:
+    """Engine stub answering correctly with probability ``p_correct``.
+
+    Deterministic (seeded) per construction — the reproducible offline
+    backend for documenting/testing the self-consistency voting effect
+    (EM rising with N) without a model. Shared by
+    ``tests/test_gsm8k_eval.py`` and ``examples/gsm8k_em_vs_n.py`` so
+    the recorded EM_VS_N.md table and the tested behavior cannot drift
+    apart.
+    """
+
+    def __init__(self, problems: list[Problem], p_correct: float = 0.6):
+        self._rng = random.Random(123)
+        self._gold = {
+            p.question: extract_final_number(p.answer) for p in problems
+        }
+        self.p = p_correct
+
+    def generate_texts(
+        self,
+        prompts,
+        temperatures=None,
+        seed=0,
+        max_new_tokens=None,
+        sampler=None,
+    ):
+        from llm_consensus_tpu.engine.engine import EngineResult
+
+        out = []
+        for prompt in prompts:
+            gold = next(g for q, g in self._gold.items() if q in prompt)
+            ans = (
+                gold
+                if self._rng.random() < self.p
+                else str(int(gold) + self._rng.randint(1, 9))
+            )
+            out.append(
+                EngineResult(
+                    text=f"Reasoning... #### {ans}",
+                    num_tokens=8,
+                    logprob=-1.0,
+                    token_ids=[],
+                )
+            )
+        return out

@@ -1,0 +1,151 @@
+"""Real-data GSM8K path (VERDICT r2 #6).
+
+The bundled ``eval/data/gsm8k_mini.jsonl`` is a 50-problem dataset in the
+exact GSM8K JSONL schema ({"question", "answer": "...#### N"}) — this
+zero-egress environment cannot download the real corpus, so the file is
+authored in-format; the *harness* (load -> prompt -> engine -> vote -> EM)
+is the thing under test, per the reference's absent test story
+(SURVEY.md §4).
+
+The HF-tokenizer leg builds a genuine ``transformers``
+``PreTrainedTokenizerFast`` offline (a BPE trained on the dataset corpus
+via the ``tokenizers`` library), so ``evaluate_self_consistency`` is
+exercised through the same tokenizer class a real checkpoint would use —
+not just the byte fallback.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer
+from llm_consensus_tpu.eval.gsm8k import (
+    evaluate_self_consistency,
+    exact_match,
+    load_gsm8k,
+)
+from llm_consensus_tpu.models.configs import get_config
+from llm_consensus_tpu.models.transformer import init_params
+
+DATA = (
+    Path(__file__).parent.parent
+    / "llm_consensus_tpu"
+    / "eval"
+    / "data"
+    / "gsm8k_mini.jsonl"
+)
+
+
+def test_bundled_dataset_loads_and_golds_extract():
+    problems = load_gsm8k(DATA)
+    assert len(problems) == 50
+    from llm_consensus_tpu.consensus.voting import extract_final_number
+
+    for p in problems:
+        assert "####" in p.answer
+        gold = extract_final_number(p.answer)
+        assert gold is not None
+        assert exact_match(gold, p.answer)
+
+
+def test_load_gsm8k_limit():
+    assert len(load_gsm8k(DATA, limit=7)) == 7
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer(tmp_path_factory):
+    """A real transformers PreTrainedTokenizerFast, built offline: BPE
+    trained on the bundled GSM8K corpus (vocab fits test-tiny's 384)."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import BPE
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.trainers import BpeTrainer
+    from transformers import PreTrainedTokenizerFast
+
+    corpus = [
+        json.loads(line)["question"] + " " + json.loads(line)["answer"]
+        for line in DATA.read_text().splitlines()
+    ]
+    tok = Tokenizer(BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    trainer = BpeTrainer(
+        vocab_size=380,
+        special_tokens=["<pad>", "<s>", "</s>", "<unk>"],
+    )
+    tok.train_from_iterator(corpus, trainer)
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.save(str(path))
+    fast = PreTrainedTokenizerFast(
+        tokenizer_file=str(path),
+        bos_token="<s>",
+        eos_token="</s>",
+        pad_token="<pad>",
+        unk_token="<unk>",
+    )
+    return HFTokenizer(fast)
+
+
+def test_hf_tokenizer_roundtrip(hf_tokenizer):
+    text = "Jordan buys 5 notebooks and pays with a $100 bill."
+    ids = hf_tokenizer.encode(text)
+    assert ids[0] == hf_tokenizer.bos_id
+    assert all(0 <= i < hf_tokenizer.vocab_size for i in ids)
+    # BPE on whitespace pre-tokenization loses only spacing fidelity.
+    assert "notebooks" in hf_tokenizer.decode(ids)
+
+
+def test_eval_harness_end_to_end_hf_tokenizer(hf_tokenizer):
+    """evaluate_self_consistency over real-format data with a real HF
+    tokenizer class and a tiny random model: the EM plumbing (prompting,
+    batched N-way sampling, answer extraction, voting) runs end to end."""
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = InferenceEngine(
+        cfg,
+        params,
+        tokenizer=hf_tokenizer,
+        engine_config=EngineConfig(
+            max_new_tokens=8, seq_buckets=(64, 128), batch_buckets=(1, 2, 4)
+        ),
+    )
+    problems = load_gsm8k(DATA, limit=3)
+    report = evaluate_self_consistency(
+        engine, problems, n=4, temperature=0.8, seed=0, max_new_tokens=8
+    )
+    assert report.n_problems == 3
+    assert report.n_candidates == 4
+    assert 0.0 <= report.em <= 1.0
+    assert report.total_candidate_tokens > 0
+    assert len(report.per_problem) == 3
+    # A random model answering real problems should essentially never be
+    # right; the point is the harness ran, not the score.
+    d = report.to_dict()
+    assert set(d) >= {"em", "n_problems", "candidate_tokens_per_sec"}
+
+
+def test_dataset_has_no_duplicate_problems():
+    problems = load_gsm8k(DATA)
+    assert len({p.question for p in problems}) == len(problems)
+
+
+def test_em_rises_with_n_on_bundled_data():
+    """Majority vote over a 60%-accurate candidate stream: EM at N=9
+    must beat EM at N=1 on the bundled 50 problems (the self-consistency
+    effect the north-star metric measures). OracleEngine is the shared
+    eval helper — the same stream examples/gsm8k_em_vs_n.py records."""
+    from llm_consensus_tpu.eval.gsm8k import OracleEngine
+
+    problems = load_gsm8k(DATA)
+    em = {}
+    for n in (1, 9):
+        engine = OracleEngine(problems, p_correct=0.6)
+        report = evaluate_self_consistency(
+            engine, problems, n=n, temperature=0.7, seed=0
+        )
+        em[n] = report.em
+    assert em[9] > em[1]
+    assert em[9] >= 0.8  # majority of 9 at p=.6 is right ~73%+ of the time
