@@ -29,8 +29,7 @@ def main() -> int:
     p.add_argument("--model", default="llama-1b")
     # Default N matches BASELINE.json's north-star config (N=64
     # self-consistency). Decode is weight-bandwidth-bound, so candidate
-    # throughput scales near-linearly in N on one chip (measured:
-    # N=16 -> 4.3k, N=64 -> 16.1k, N=128 -> 33.7k tok/s/chip, int8).
+    # throughput scales near-linearly in N on one chip.
     p.add_argument("--n-candidates", type=int, default=64)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--new-tokens", type=int, default=128)
@@ -54,6 +53,11 @@ def main() -> int:
         choices=("none", "int8"),
         help="KV-cache quantization (the dominant HBM term at large N)",
     )
+    p.add_argument(
+        "--no-pallas",
+        action="store_true",
+        help="skip the fused Pallas kernels (XLA-only decode path)",
+    )
     args = p.parse_args()
 
     if args.cpu:
@@ -67,7 +71,25 @@ def main() -> int:
 
     cfg = get_config(args.model)
     dev = jax.devices()[0]
-    print(f"[bench] model={cfg.name} device={dev.platform}", file=sys.stderr)
+    # Fused Pallas kernels are single-chip TPU only (pallas_call is
+    # opaque to GSPMD); default them on exactly there. The quant matmul
+    # has its own auto-gate — align it so --no-pallas (and the fallback
+    # below) really runs a kernel-free program.
+    use_pallas = (
+        not args.no_pallas
+        and dev.platform == "tpu"
+        and jax.device_count() == 1
+    )
+    cfg = cfg.with_(use_pallas=use_pallas)
+    from llm_consensus_tpu.ops import quant as _quant
+
+    if not use_pallas:
+        _quant.set_kernel_enabled(False)
+    print(
+        f"[bench] model={cfg.name} device={dev.platform} "
+        f"pallas={use_pallas}",
+        file=sys.stderr,
+    )
 
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     if args.quant == "int8":
@@ -80,25 +102,48 @@ def main() -> int:
     temps = jnp.full((b,), 0.7, jnp.float32)
     key = jax.random.PRNGKey(0)
 
-    def run(seed_key):
-        out = generate(
-            cfg,
-            params,
-            tokens,
-            lengths,
-            seed_key,
-            temps,
-            max_new_tokens=args.new_tokens,
-            eos_id=-1,  # never stop early: fixed work per run
-            # Self-consistency semantics: N candidates share one prompt.
-            shared_prefill=not args.no_shared_prefill,
-            kv_quant=args.kv_quant == "int8",
-        )
-        return out.tokens
+    def make_run(run_cfg):
+        def run(seed_key):
+            out = generate(
+                run_cfg,
+                params,
+                tokens,
+                lengths,
+                seed_key,
+                temps,
+                max_new_tokens=args.new_tokens,
+                eos_id=-1,  # never stop early: fixed work per run
+                # Self-consistency semantics: N candidates share one prompt.
+                shared_prefill=not args.no_shared_prefill,
+                kv_quant=args.kv_quant == "int8",
+            )
+            return out.tokens
 
-    # Warmup/compile.
+        return run
+
+    run = make_run(cfg)
+    fallback = ""
+
+    # Warmup/compile. A kernel regression must never zero the bench: if
+    # the Pallas path fails to lower, record the XLA path instead and
+    # say so in the metric string.
     t0 = time.perf_counter()
-    run(key).block_until_ready()
+    try:
+        run(key).block_until_ready()
+    except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
+        if not cfg.use_pallas:
+            raise
+        print(
+            f"[bench] Pallas path failed ({type(e).__name__}: {e}); "
+            "falling back to the XLA decode path",
+            file=sys.stderr,
+        )
+        cfg = cfg.with_(use_pallas=False)
+        _quant.set_kernel_enabled(False)
+        run = make_run(cfg)
+        fallback = " FALLBACK:no-pallas"
+        t0 = time.perf_counter()
+        run(key).block_until_ready()
     compile_s = time.perf_counter() - t0
     print(f"[bench] compile+first run: {compile_s:.1f}s", file=sys.stderr)
 
@@ -118,7 +163,7 @@ def main() -> int:
             {
                 "metric": f"candidate-tokens/sec/chip ({cfg.name}, N={b}, "
                 f"decode {args.new_tokens} @ prompt {s}, quant={args.quant}, "
-                f"kv={args.kv_quant})",
+                f"kv={args.kv_quant}, pallas={cfg.use_pallas}{fallback})",
                 "value": round(tps_per_chip, 2),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tps_per_chip / 1000.0, 4),

@@ -76,8 +76,17 @@ def maybe_dequantize(leaf, dtype=jnp.bfloat16):
     return leaf
 
 
-# Test hook: None = kernel on TPU only; True/False forces.
+# Kernel override: None = auto (kernel on single-chip TPU); True/False
+# forces. Settable by tests and by bench.py's no-Pallas/fallback modes —
+# without it a quant_matmul lowering regression would be unreachable by
+# any fallback (this is the only gate on the kernel).
 _FORCE_KERNEL: bool | None = None
+
+
+def set_kernel_enabled(enabled: bool | None) -> None:
+    """Force the fused int8 kernel on/off; None restores auto-detect."""
+    global _FORCE_KERNEL
+    _FORCE_KERNEL = enabled
 
 
 def _use_kernel() -> bool:

@@ -86,7 +86,14 @@ def initialize_distributed(config: DistributedConfig | None = None) -> bool:
             num_processes=config.num_processes,
             process_id=config.process_id,
         )
-    except Exception as e:  # noqa: BLE001 - single-host fallback
+    except Exception as e:  # noqa: BLE001
+        if explicit:
+            # The caller configured a real multi-process job; silently
+            # proceeding single-host would train divergent replicas.
+            raise RuntimeError(
+                "jax.distributed.initialize failed for explicitly "
+                f"configured job {config}: {e}"
+            ) from e
         log.warning("jax.distributed.initialize failed (%s); single host", e)
         return False
     log.info(

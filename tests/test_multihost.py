@@ -55,3 +55,29 @@ def test_host_array_to_global_single_process(cpu_devices):
 def test_dcn_axis_divisibility_check():
     with pytest.raises(ValueError, match="divisible"):
         make_multislice_mesh(MeshConfig(data=3), dcn_axis="data", n_slices=2)
+
+
+def test_initialize_raises_on_explicit_config_failure(monkeypatch):
+    """An explicitly configured multi-process job must NOT silently fall
+    back to single-host (divergent replicas); it raises (ADVICE r1)."""
+    from llm_consensus_tpu.parallel.multihost import (
+        DistributedConfig,
+        initialize_distributed,
+    )
+
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: False
+    )
+
+    def boom(**kw):
+        raise ConnectionError("coordinator unreachable")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="explicitly"):
+        initialize_distributed(
+            DistributedConfig(
+                coordinator_address="10.0.0.1:1234",
+                num_processes=2,
+                process_id=0,
+            )
+        )
