@@ -40,8 +40,9 @@ class EngineConfig:
     # Batch-size buckets (padded up with dummy rows).
     batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
     sampler: SamplerConfig = field(default_factory=SamplerConfig)
-    # "int8" = weight-only per-channel quantization at engine init
-    # (ops/quant.py): halves weight HBM traffic on the decode hot loop.
+    # Weight-only per-channel quantization at engine init (ops/quant.py):
+    # "int8" halves weight HBM traffic on the decode hot loop, "int4"
+    # (packed nibbles) halves it again at reduced precision.
     quant: str = "none"
     # int8 KV cache (models/cache.QuantKVCache): halves cache HBM
     # traffic per decode step (the dominant term at large N).
@@ -83,10 +84,12 @@ class InferenceEngine:
                 f"vocab {cfg.vocab_size}"
             )
         self.config = engine_config or EngineConfig()
-        if self.config.quant == "int8":
+        if self.config.quant in ("int8", "int4"):
             from llm_consensus_tpu.ops.quant import quantize_params
 
-            self.params = quantize_params(self.params)
+            self.params = quantize_params(
+                self.params, bits=8 if self.config.quant == "int8" else 4
+            )
         elif self.config.quant != "none":
             raise ValueError(f"unknown quant mode {self.config.quant!r}")
         self.mesh = mesh

@@ -120,3 +120,99 @@ def quant_matmul_supported(m: int, k: int, n: int) -> bool:
         and k * 128 <= _MAX_W_TILE_BYTES  # smallest tile must fit
         and _pick_block(n, target=_blk_target(k)) is not None
     )
+
+
+# ---------------------------------------------------------------------------
+# int4 (packed-nibble) variant
+#
+# STATUS: numerics verified (interpret mode, tests/test_quant.py); the
+# small-shape unpack lowers and runs on real TPU, but full-size compiles
+# (K=2048, N=32000) have shown pathological Mosaic compile times on this
+# environment's toolchain. The kernel is therefore OPT-IN via
+# ops.quant.set_kernel4_enabled(True) — the default int4 path is the jnp
+# unpack + XLA dot (capacity win, no decode-bandwidth win).
+# ---------------------------------------------------------------------------
+
+
+def _q4mm_kernel(x_ref, w_ref, s_ref, o_ref):
+    """One N-block program: o = (x @ bf16(unpack4(w))) * scale.
+
+    x_ref: [M, K] bf16; w_ref: [K/2, blk_n] int8 (two nibbles/byte,
+    low nibbles = rows [0, K/2), high = [K/2, K) — the
+    ops.quant.Quantized4Tensor contract); s_ref: [1, blk_n] f32.
+    Bit ops run in int32 — int8 shifts don't legalize on Mosaic — and
+    the K split becomes TWO dots (x_low @ low + x_high @ high) instead
+    of a sublane concat of the unpacked halves.
+    """
+    k2 = w_ref.shape[0]
+    w32 = w_ref[...].astype(jnp.int32)
+    low = ((w32 & 0xF) - ((w32 & 0x8) << 1)).astype(jnp.bfloat16)
+    nib = (w32 >> 4) & 0xF
+    high = (nib - ((nib & 0x8) << 1)).astype(jnp.bfloat16)
+    dn = (((1,), (0,)), ((), ()))
+    acc = jax.lax.dot_general(
+        x_ref[:, :k2], low, dn, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        x_ref[:, k2:], high, dn, preferred_element_type=jnp.float32
+    )
+    o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
+
+
+def quant4_matmul_2d(
+    x: jnp.ndarray,
+    w_q: jnp.ndarray,
+    scale: jnp.ndarray,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """x [M, K] x packed-int4 w_q [K/2, N] (per-column ``scale`` [1, N])
+    -> [M, N]."""
+    m, k = x.shape
+    k2, n = w_q.shape
+    if k != 2 * k2:
+        raise ValueError(f"contraction mismatch {k} vs packed 2*{k2}")
+    blk_n = _pick_block(n, target=_blk4_target(k))
+    if blk_n is None:
+        raise ValueError(
+            f"N={n} (K={k}) has no 128-aligned block within the VMEM budget"
+        )
+    if interpret is None:
+        interpret = _interpret_default()
+    out_dtype = out_dtype or x.dtype
+
+    return pl.pallas_call(
+        _q4mm_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid=(n // blk_n,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (k2, blk_n), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, blk_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (m, blk_n), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), w_q, scale.astype(jnp.float32))
+
+
+def _blk4_target(k: int) -> int:
+    """blk_n budget for int4: the unpacked bf16 tile (K x blk_n x 2B) is
+    4x the packed bytes, so budget against THAT."""
+    by_vmem = (_MAX_W_TILE_BYTES // max(2 * k, 1)) // 128 * 128
+    return max(128, min(512, by_vmem))
+
+
+def quant4_matmul_supported(m: int, k: int, n: int) -> bool:
+    return (
+        m <= _MAX_M
+        and m * k * 2 <= _MAX_X_BYTES
+        and k % 2 == 0
+        and n % 128 == 0
+        and (k // 2) % 8 == 0  # packed sublane tiling
+        and k % 128 == 0
+        and 2 * k * 128 <= _MAX_W_TILE_BYTES  # smallest unpacked tile
+        and _pick_block(n, target=_blk4_target(k)) is not None
+    )

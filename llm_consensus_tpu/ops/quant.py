@@ -69,10 +69,76 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jnp.ndarray:
     return qt.q.astype(dtype) * qt.scale.astype(dtype)
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class Quantized4Tensor:
+    """int4 weight (two nibbles per int8 byte) + fp32 per-channel scale.
+
+    Packing contract: the CONTRACTION axis is always the second-to-last
+    axis of the logical weight (true for every quantized leaf layout:
+    dense [L, K, N], MoE [L, E, K, F], lm_head [K, N]); rows [0, K/2)
+    live in the low nibbles and rows [K/2, K) in the high nibbles, so
+    ``q``'s contraction dim is K/2 and unpack is a concat — no
+    per-element interleave. Halves weight HBM bytes vs int8 (decode's
+    bandwidth floor) at int4 precision (symmetric, amax/7).
+    """
+
+    q: jnp.ndarray  # int8 carrying 2x int4; contraction dim halved
+    scale: jnp.ndarray  # float32, logical shape with contraction dim = 1
+
+    @property
+    def shape(self):  # logical (unpacked) shape
+        s = list(self.q.shape)
+        s[-2] *= 2
+        return tuple(s)
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def quantize_tensor4(w: jnp.ndarray, axis: int) -> Quantized4Tensor:
+    """Symmetric per-channel int4: q = round(w/s) in [-8, 7], s = amax/7.
+
+    ``axis`` must be the second-to-last axis (the packing contract) and
+    even-sized.
+    """
+    if axis % w.ndim != w.ndim - 2:
+        raise ValueError(
+            f"int4 packs along axis -2; got axis {axis} for rank {w.ndim}"
+        )
+    k = w.shape[axis]
+    if k % 2:
+        raise ValueError(f"contraction dim {k} must be even for int4")
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(w32 / scale), -8, 7).astype(jnp.int32)
+    low, high = jnp.split(q, 2, axis=axis)
+    packed = ((low & 0xF) | ((high & 0xF) << 4)).astype(jnp.int8)
+    return Quantized4Tensor(q=packed, scale=scale)
+
+
+def unpack4(packed: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Nibbles -> values in [-8, 7], restoring the logical contraction
+    dim (int32 bit ops — int8 shifts don't legalize on Mosaic)."""
+    w32 = packed.astype(jnp.int32)
+    low = (w32 & 0xF) - ((w32 & 0x8) << 1)
+    nib = (w32 >> 4) & 0xF
+    high = nib - ((nib & 0x8) << 1)
+    return jnp.concatenate([low, high], axis=-2).astype(dtype)
+
+
+def dequantize4(qt: Quantized4Tensor, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return unpack4(qt.q, dtype) * qt.scale.astype(dtype)
+
+
 def maybe_dequantize(leaf, dtype=jnp.bfloat16):
-    """Pass-through for plain arrays; dequantize QuantizedTensor leaves."""
+    """Pass-through for plain arrays; dequantize quantized leaves."""
     if isinstance(leaf, QuantizedTensor):
         return dequantize(leaf, dtype)
+    if isinstance(leaf, Quantized4Tensor):
+        return dequantize4(leaf, dtype)
     return leaf
 
 
@@ -89,6 +155,29 @@ def set_kernel_enabled(enabled: bool | None) -> None:
     _FORCE_KERNEL = enabled
 
 
+# The int4 kernel is OPT-IN only (no auto-detect): its nibble-unpack bit
+# ops have shown pathological Mosaic compile times on some toolchain
+# versions, and a wedged compile service is worse than the jnp fallback
+# (which still stores int4 in HBM — capacity win — but lets XLA
+# materialize the dequant, losing the bandwidth win inside scan).
+_FORCE_KERNEL4: bool = False
+
+
+def set_kernel4_enabled(enabled: bool) -> None:
+    """Enable the fused int4 matmul kernel (verify it compiles on your
+    jax/libtpu first — see ops/pallas/quant_matmul.py)."""
+    global _FORCE_KERNEL4
+    _FORCE_KERNEL4 = enabled
+
+
+def _use_kernel4() -> bool:
+    return (
+        _FORCE_KERNEL4
+        and jax.default_backend() == "tpu"
+        and jax.device_count() == 1
+    )
+
+
 def _use_kernel() -> bool:
     if _FORCE_KERNEL is not None:
         return _FORCE_KERNEL
@@ -96,6 +185,47 @@ def _use_kernel() -> bool:
     # multi-device mesh the kernel would force TP/EP-sharded weights to
     # be all-gathered — the XLA dequant fallback shards fine there.
     return jax.default_backend() == "tpu" and jax.device_count() == 1
+
+
+def _try_kernel_matmul(x, leaf, out_dtype):
+    """Shared fused-kernel dispatch for int8/int4 weights.
+
+    Returns the kernel result, or None when the kernel is gated off or
+    the shapes don't tile (caller falls back to dequant + XLA dot).
+    """
+    if leaf.q.ndim != 2:
+        return None
+    if isinstance(leaf, QuantizedTensor):
+        if not _use_kernel():
+            return None
+        from llm_consensus_tpu.ops.pallas.quant_matmul import (
+            quant_matmul_2d as kernel,
+        )
+        from llm_consensus_tpu.ops.pallas.quant_matmul import (
+            quant_matmul_supported as supported,
+        )
+
+        k = leaf.q.shape[0]
+    else:
+        if not _use_kernel4():
+            return None
+        from llm_consensus_tpu.ops.pallas.quant_matmul import (
+            quant4_matmul_2d as kernel,
+        )
+        from llm_consensus_tpu.ops.pallas.quant_matmul import (
+            quant4_matmul_supported as supported,
+        )
+
+        k = 2 * leaf.q.shape[0]  # logical contraction dim (packed)
+    n = leaf.q.shape[1]
+    lead = x.shape[:-1]
+    m = 1
+    for s in lead:
+        m *= s
+    if not supported(m, k, n):
+        return None
+    out = kernel(x.reshape(m, k), leaf.q, leaf.scale, out_dtype=out_dtype)
+    return out.reshape(*lead, n)
 
 
 def matmul(x: jnp.ndarray, leaf, out_dtype=None) -> jnp.ndarray:
@@ -108,24 +238,11 @@ def matmul(x: jnp.ndarray, leaf, out_dtype=None) -> jnp.ndarray:
     ops/pallas/quant_matmul.py); other shapes and sharded runs fall back
     to dequant + XLA dot.
     """
-    if isinstance(leaf, QuantizedTensor):
-        if leaf.q.ndim == 2 and _use_kernel():
-            from llm_consensus_tpu.ops.pallas.quant_matmul import (
-                quant_matmul_2d,
-                quant_matmul_supported,
-            )
-
-            lead = x.shape[:-1]
-            k, n = leaf.q.shape
-            m = 1
-            for s in lead:
-                m *= s
-            if quant_matmul_supported(m, k, n):
-                out = quant_matmul_2d(
-                    x.reshape(m, k), leaf.q, leaf.scale, out_dtype=out_dtype
-                )
-                return out.reshape(*lead, n)
-        w = dequantize(leaf, x.dtype)
+    if isinstance(leaf, (QuantizedTensor, Quantized4Tensor)):
+        out = _try_kernel_matmul(x, leaf, out_dtype)
+        if out is not None:
+            return out
+        w = maybe_dequantize(leaf, x.dtype)
     else:
         w = leaf
     if out_dtype is not None:
@@ -135,13 +252,21 @@ def matmul(x: jnp.ndarray, leaf, out_dtype=None) -> jnp.ndarray:
     return x @ w
 
 
-def quantize_params(params: dict, *, quantize_lm_head: bool = True) -> dict:
+def quantize_params(
+    params: dict, *, quantize_lm_head: bool = True, bits: int = 8
+) -> dict:
     """Quantize the large matmul weights of an ``init_params`` tree.
 
     Norms, biases, the router (tiny), and the embedding gather table stay
     in their original dtype. Works for dense and MoE block layouts (the
-    MoE leaves carry an extra leading expert axis).
+    MoE leaves carry an extra leading expert axis). ``bits``: 8 (int8,
+    amax/127) or 4 (packed int4, amax/7 — half the HBM bytes again at
+    reduced precision).
     """
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
+    qfn = quantize_tensor if bits == 8 else quantize_tensor4
+    qtypes = (QuantizedTensor, Quantized4Tensor)
     out = dict(params)
     blocks = dict(params["blocks"])
     for name, w in blocks.items():
@@ -150,13 +275,13 @@ def quantize_params(params: dict, *, quantize_lm_head: bool = True) -> dict:
             if (name in _QUANT_AXES_MOE and w.ndim == 4)
             else _QUANT_AXES_DENSE
         )
-        if name in axes and not isinstance(w, QuantizedTensor):
-            blocks[name] = quantize_tensor(w, axes[name])
+        if name in axes and not isinstance(w, qtypes):
+            blocks[name] = qfn(w, axes[name])
     out["blocks"] = blocks
     if quantize_lm_head and "lm_head" in params and not isinstance(
-        params["lm_head"], QuantizedTensor
+        params["lm_head"], qtypes
     ):
-        out["lm_head"] = quantize_tensor(params["lm_head"], axis=0)
+        out["lm_head"] = qfn(params["lm_head"], axis=0)
     return out
 
 

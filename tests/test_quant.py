@@ -165,3 +165,131 @@ def test_engine_quant_config():
     assert len(results) == 1 and isinstance(results[0].text, str)
     with pytest.raises(ValueError):
         InferenceEngine(cfg, params, engine_config=EngineConfig(quant="fp4"))
+
+
+# ---------------------------------------------------------------------------
+# int4 (packed nibbles)
+# ---------------------------------------------------------------------------
+
+
+def test_int4_pack_unpack_exact():
+    """Values on the int4 grid survive pack -> unpack exactly."""
+    from llm_consensus_tpu.ops.quant import quantize_tensor4, unpack4
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    qt = quantize_tensor4(w, axis=0)
+    assert qt.q.shape == (32, 128)  # contraction dim halved
+    assert qt.shape == (64, 128)  # logical shape
+    grid = unpack4(qt.q, jnp.float32)
+    assert float(jnp.min(grid)) >= -8 and float(jnp.max(grid)) <= 7
+    # Re-quantizing the dequantized weight reproduces the same nibbles.
+    from llm_consensus_tpu.ops.quant import dequantize4
+
+    qt2 = quantize_tensor4(dequantize4(qt, jnp.float32), axis=0)
+    assert jnp.array_equal(qt.q, qt2.q)
+
+
+def test_int4_roundtrip_error_bound():
+    from llm_consensus_tpu.ops.quant import dequantize4, quantize_tensor4
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    qt = quantize_tensor4(w, axis=0)
+    err = jnp.abs(dequantize4(qt, jnp.float32) - w)
+    assert float(jnp.max(err - qt.scale / 2)) < 1e-6
+
+
+def test_int4_rejects_bad_axis_or_odd_dim():
+    from llm_consensus_tpu.ops.quant import quantize_tensor4
+
+    w = jnp.zeros((64, 128))
+    with pytest.raises(ValueError, match="axis -2"):
+        quantize_tensor4(w, axis=1)
+    with pytest.raises(ValueError, match="even"):
+        quantize_tensor4(jnp.zeros((63, 128)), axis=0)
+
+
+def test_quant4_matmul_kernel_matches_dequant():
+    """Fused int4 kernel (interpret) == unpack + XLA dot."""
+    from llm_consensus_tpu.ops.pallas.quant_matmul import (
+        quant4_matmul_2d,
+        quant4_matmul_supported,
+    )
+    from llm_consensus_tpu.ops.quant import dequantize4, quantize_tensor4
+
+    k, n, m = 256, 384, 8
+    w = jax.random.normal(jax.random.PRNGKey(0), (k, n), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.bfloat16)
+    qt = quantize_tensor4(w, axis=0)
+    assert quant4_matmul_supported(m, k, n)
+    got = quant4_matmul_2d(x, qt.q, qt.scale, interpret=True)
+    want = (x.astype(jnp.float32) @ dequantize4(qt, jnp.float32)).astype(
+        jnp.bfloat16
+    )
+    assert float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)))) < 0.5
+
+
+@pytest.mark.parametrize("preset", ["test-tiny", "test-tiny-moe"])
+def test_int4_forward_close(preset):
+    """int4 logits stay reasonably close to full precision (coarser grid
+    than int8, so a looser bound)."""
+    from llm_consensus_tpu.ops.quant import Quantized4Tensor
+
+    cfg = get_config(preset)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qp = quantize_params(params, bits=4)
+    assert isinstance(qp["blocks"]["wq"], Quantized4Tensor)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size
+    )
+    ref = forward(cfg, params, tokens)
+    out = forward(cfg, qp, tokens)
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.35
+
+
+def test_int4_bytes_half_of_int8():
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    q8 = quantize_params(params, bits=8)
+    q4 = quantize_params(params, bits=4)
+
+    def block_bytes(p):
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+            for leaf in [getattr(p["blocks"][name], "q")]
+        )
+
+    assert block_bytes(q4) == block_bytes(q8) // 2
+
+
+def test_int4_engine_generates():
+    """End-to-end: the engine decodes with int4 weights."""
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = InferenceEngine(
+        cfg,
+        params,
+        engine_config=EngineConfig(
+            max_new_tokens=4, seq_buckets=(16,), batch_buckets=(1, 2),
+            quant="int4",
+        ),
+    )
+    out = eng.generate_texts(["hello", "world"])
+    assert len(out) == 2
+    assert all(r.num_tokens >= 1 for r in out)
+
+
+def test_int4_params_shard_on_mesh():
+    """Packed int4 leaves place under the same partitioning rules."""
+    from jax.sharding import PartitionSpec as P
+
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llm_consensus_tpu.parallel.partitioning import shard_params
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    qp = quantize_params(params, bits=4)
+    mesh = make_mesh(MeshConfig(data=2, model=2, expert=2))
+    sharded = shard_params(qp, mesh)
+    assert sharded["blocks"]["wq"].q.sharding.spec == P(None, None, "model")
