@@ -1,0 +1,235 @@
+"""Greedy speculative decoding: draft k tokens, verify in one pass.
+
+Decode on TPU is weight-HBM-bound — every sequential step re-reads the
+target's weights. Speculative decoding (Leviathan et al.) breaks the
+sequential bottleneck: a cheap draft model proposes ``k_spec`` tokens
+autoregressively, then the target scores the WHOLE draft in one
+:func:`llm_consensus_tpu.models.transformer.decode_chunk` forward and
+accepts the longest matching prefix. Accepted tokens cost one target
+weight-read per ``k_spec`` instead of one per token.
+
+The ragged KV-cache design makes rollback free: acceptance only sets
+``cache.length`` (data, not shape) — rejected tokens' k/v stay as
+masked-out garbage past the fill and are overwritten later, exactly
+like prefill padding.
+
+v1 scope: greedy only (temperature 0), bf16 caches. The key invariant —
+tested in tests/test_speculative.py — is EXACTNESS: output tokens equal
+vanilla greedy decode token-for-token for ANY draft model; the draft
+only affects speed. (Sampled speculative decoding needs the
+accept-with-prob-p(t)/p(d) residual scheme; the verification chunk op
+and cache plumbing here are the hard part and are sampling-agnostic.)
+
+The reference has no decoding at all to speed up (remote API,
+``src/main.rs:82-86``); this is the TPU build's own perf work past
+BASELINE.json's floor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.configs import ModelConfig
+from llm_consensus_tpu.models.transformer import (
+    decode_chunk,
+    decode_step,
+    prefill,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SpecOutput:
+    tokens: jnp.ndarray  # [B, max_new_tokens] int32, pad-filled after EOS
+    num_tokens: jnp.ndarray  # [B] int32 generated tokens incl. EOS
+    rounds: jnp.ndarray  # [] int32 — speculation rounds taken
+    drafted: jnp.ndarray  # [] int32 — draft tokens proposed in total
+    accepted: jnp.ndarray  # [] int32 — draft tokens accepted in total
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg_t",
+        "cfg_d",
+        "max_new_tokens",
+        "k_spec",
+        "eos_id",
+        "pad_id",
+        "cache_len",
+    ),
+)
+def speculative_generate(
+    cfg_t: ModelConfig,
+    params_t: dict,
+    cfg_d: ModelConfig,
+    params_d: dict,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    k_spec: int = 4,
+    eos_id: int = 2,
+    pad_id: int = 0,
+    cache_len: int | None = None,
+) -> SpecOutput:
+    """Greedy speculative decode of right-padded prompts.
+
+    tokens: [B, S] int32; lengths: [B]. The draft (``cfg_d/params_d``)
+    must share the target's tokenizer/vocab. Each round: the draft
+    proposes ``k_spec`` greedy tokens; the target verifies them with one
+    ``decode_chunk`` over ``k_spec + 1`` inputs; the ``n_acc`` leading
+    matches are emitted plus one more target token — the correction on a
+    mismatch, the FREE bonus token on full acceptance (so a perfect
+    round yields ``k_spec + 1`` tokens from one target forward). Every
+    round emits >= 1 token, so at most ``max_new_tokens`` rounds run
+    (the while_loop is data-dependent — decode stops as soon as every
+    row is done).
+    """
+    b, s = tokens.shape
+    if cache_len is None:
+        # +k_spec+1 slack: a chunk may write past the last emitted slot.
+        cache_len = s + max_new_tokens + k_spec + 1
+    if cache_len < s + max_new_tokens + k_spec + 1:
+        raise ValueError(f"cache_len {cache_len} too small")
+
+    cache_t = KVCache.create(cfg_t, b, cache_len)
+    logits_t, cache_t = prefill(cfg_t, params_t, tokens, lengths, cache_t)
+    cache_d = KVCache.create(cfg_d, b, cache_len)
+    _, cache_d = prefill(cfg_d, params_d, tokens, lengths, cache_d)
+
+    # First token comes from the target's prefill logits directly.
+    tok0 = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # [B]
+    out0 = jnp.full((b, max_new_tokens), pad_id, jnp.int32)
+    out0 = out0.at[:, 0].set(tok0)
+    n0 = jnp.ones((b,), jnp.int32)
+    done0 = (tok0 == eos_id) | (max_new_tokens <= 1)
+
+    def cond(state):
+        _, _, _, _, n_out, done, rounds, _, _ = state
+        return jnp.any(~done) & (rounds < max_new_tokens)
+
+    def body(state):
+        tok, cache_t, cache_d, out, n_out, done, rounds, drafted, accepted = (
+            state
+        )
+        done_before = done
+        len_t0 = cache_t.length
+        len_d0 = cache_d.length
+
+        # --- Draft proposes k_spec greedy tokens -----------------------
+        def dstep(carry, _):
+            x, cd = carry
+            lg, cd = decode_step(cfg_d, params_d, x[:, None], cd)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (nxt, cd), nxt
+
+        (_, cache_d), drafts = jax.lax.scan(
+            dstep, (tok, cache_d), None, length=k_spec
+        )
+        drafts = drafts.T  # [B, K]
+        # One extra draft step consuming d_{K-1}: on full acceptance the
+        # bonus token becomes the next input, and the draft cache must
+        # then hold d_{K-1}'s k/v (its logits are discarded).
+        _, cache_d = decode_step(cfg_d, params_d, drafts[:, -1:], cache_d)
+
+        # --- Target verifies the whole draft in one chunk --------------
+        # Chunk inputs: [tok, d_0 .. d_{K-1}] (K+1); logits_j predicts
+        # the token after consuming input j, so g_j verifies d_j for
+        # j < K, and g_K is the FREE bonus token after a fully accepted
+        # draft (Leviathan et al.) — k_spec+1 tokens from one target
+        # forward.
+        chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
+        logits, cache_t = decode_chunk(cfg_t, params_t, chunk, cache_t)
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+
+        match = drafts == targets[:, :k_spec]  # [B, K]
+        acc_mask = jnp.cumprod(match.astype(jnp.int32), axis=1)  # [B, K]
+        n_acc = jnp.sum(acc_mask, axis=1)  # [B] in [0, K]
+
+        # Emitted this round: accepted drafts, then the target token at
+        # position n_acc — the correction on a mismatch, the bonus on
+        # full acceptance. Uniformly n_acc + 1 tokens.
+        j = jnp.arange(k_spec + 1)[None, :]
+        emit = jnp.where(
+            j < n_acc[:, None],
+            jnp.pad(drafts, ((0, 0), (0, 1))),
+            jnp.where(j == n_acc[:, None], targets, pad_id),
+        )  # [B, K+1]
+        emit_cnt = n_acc + 1  # [B]
+
+        # EOS inside the round truncates it.
+        is_eos = (emit == eos_id) & (j < emit_cnt[:, None])
+        any_eos = jnp.any(is_eos, axis=1)
+        eos_pos = jnp.argmax(is_eos, axis=1)
+        emit_cnt = jnp.where(any_eos, eos_pos + 1, emit_cnt)
+
+        # Rows already done (or out of budget) emit nothing.
+        emit_cnt = jnp.where(done, 0, emit_cnt)
+        emit_cnt = jnp.minimum(emit_cnt, max_new_tokens - n_out)
+
+        # Scatter into the output buffer at per-row offsets.
+        batch = jnp.arange(b)
+        new_out = out
+        for jj in range(k_spec + 1):  # static, small
+            idx = jnp.clip(n_out + jj, 0, max_new_tokens - 1)
+            write = jj < emit_cnt
+            new_out = new_out.at[batch, idx].set(
+                jnp.where(write, emit[:, jj], new_out[batch, idx])
+            )
+
+        # Next input token: last emitted (correction or bonus).
+        last = jnp.clip(emit_cnt - 1, 0, k_spec)
+        tok_next = jnp.where(
+            emit_cnt > 0, emit[batch, last], tok
+        ).astype(jnp.int32)
+
+        # Cache fills: consumed chunk inputs = emit_cnt (the next input's
+        # k/v is not yet written — decode_step convention). Done rows
+        # keep their fill.
+        consumed = emit_cnt
+        cache_t = cache_t.with_length(len_t0 + consumed)
+        cache_d = cache_d.with_length(len_d0 + consumed)
+
+        n_out = n_out + emit_cnt
+        done = done | any_eos | (n_out >= max_new_tokens)
+        drafted = drafted + k_spec * jnp.sum((~done_before).astype(jnp.int32))
+        accepted = accepted + jnp.sum(jnp.minimum(n_acc, emit_cnt))
+        return (
+            tok_next,
+            cache_t,
+            cache_d,
+            new_out,
+            n_out,
+            done,
+            rounds + 1,
+            drafted,
+            accepted,
+        )
+
+    zero = jnp.zeros((), jnp.int32)
+    state = (
+        tok0,
+        cache_t,
+        cache_d,
+        out0,
+        n0,
+        done0,
+        zero,
+        zero,
+        zero,
+    )
+    state = jax.lax.while_loop(cond, body, state)
+    _, _, _, out, n_out, _, rounds, drafted, accepted = state
+    return SpecOutput(
+        tokens=out,
+        num_tokens=n_out,
+        rounds=rounds,
+        drafted=drafted,
+        accepted=accepted,
+    )

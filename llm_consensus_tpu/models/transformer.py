@@ -36,7 +36,11 @@ import jax.numpy as jnp
 from llm_consensus_tpu.models.cache import KVCache, QuantKVCache, quantize_kv
 from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.ops.activations import swiglu
-from llm_consensus_tpu.ops.attention import causal_attention, decode_attention
+from llm_consensus_tpu.ops.attention import (
+    causal_attention,
+    chunk_decode_attention,
+    decode_attention,
+)
 from llm_consensus_tpu.ops.norms import rms_norm
 from llm_consensus_tpu.ops.quant import matmul as _qmm
 from llm_consensus_tpu.ops.quant import maybe_dequantize as _w
@@ -324,6 +328,22 @@ def _block(
                 ks_l.at[:, :, :s].set(ks.transpose(0, 2, 1)),
                 vs_l.at[:, :, :s].set(vs.transpose(0, 2, 1)),
             )
+    elif mode == "chunk":
+        # K-token speculative-verification chunk: write all K tokens'
+        # k/v at slots [valid_len, valid_len + K) (ragged per row), then
+        # ragged-causal attention over the cache. bf16 cache only (the
+        # int8 head-major scatter layout isn't worth the complexity on
+        # the verification path).
+        if len(kv_layer) != 2:
+            raise ValueError("chunk decode requires the bf16 KV cache")
+        b, kq = x.shape[0], x.shape[1]
+        k_l, v_l = kv_layer
+        batch_idx = jnp.arange(b)[:, None]  # [B, 1]
+        pos = valid_len[:, None] + jnp.arange(kq)[None, :]  # [B, K]
+        new_k = k_l.at[batch_idx, pos].set(k.astype(k_l.dtype))
+        new_v = v_l.at[batch_idx, pos].set(v.astype(v_l.dtype))
+        new_kv = (new_k, new_v)
+        attn = chunk_decode_attention(q, new_k, new_v, valid_len)
     elif mode == "decode":
         b = x.shape[0]
         batch_idx = jnp.arange(b)
@@ -581,6 +601,40 @@ def decode_step_paged(
         k=new_k, v=new_v, page_table=cache.page_table, length=pos + 1
     )
     return logits, new_cache
+
+
+def decode_chunk(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Score K tokens per row against the cache in ONE forward.
+
+    tokens: [B, K]. Token (b, i) sits at position ``cache.length[b] + i``
+    and attends everything before it plus the chunk prefix — the
+    speculative-decoding verification step (a whole draft's target
+    logits from one pass instead of K sequential decode_steps).
+
+    Returns (logits [B, K, V] float32, cache with the K tokens' k/v
+    written). ``cache.length`` is NOT advanced: the caller decides how
+    many chunk tokens were actually consumed (accepted) and sets the
+    length via ``cache.with_length`` — rejected tokens' k/v stay as
+    masked-out garbage past the fill, exactly like prefill padding.
+    """
+    if cfg.sliding_window:
+        raise NotImplementedError("chunk decode with sliding window")
+    x = params["embed"][tokens]  # [B, K, D]
+    kq = tokens.shape[1]
+    positions = cache.length[:, None] + jnp.arange(kq)[None, :]
+    cos, sin = rope_cos_sin(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+    x, cache = _run_layers(
+        cfg, params, x, cos, sin, cache, "chunk", cache.length, None
+    )
+    logits = _unembed(cfg, params, x)  # [B, K, V]
+    return logits, cache
 
 
 def decode_step(

@@ -132,3 +132,30 @@ def decode_attention(
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
     return _gqa_out(probs, v_cache, q.dtype)
+
+
+def chunk_decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    valid_len: jnp.ndarray,
+) -> jnp.ndarray:
+    """K-token chunk decode against the cache (speculative verification).
+
+    q: [B, K, H, D] — K new tokens per row whose k/v are already written
+    at slots [valid_len, valid_len + K); k_cache/v_cache: [B, S, Hkv, D];
+    valid_len: [B] pre-chunk fill. Chunk token i attends cache slots
+    < valid_len + i + 1 — ragged causal within the chunk, exactly the
+    one-token :func:`decode_attention` rule extended to K queries (one
+    forward verifies a whole draft, the speculative-decoding hot path).
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = _gqa_scores(q, k_cache) * scale  # [B, Hkv, G, K, S]
+    kq = q.shape[1]
+    s = k_cache.shape[1]
+    limit = valid_len[:, None, None] + jnp.arange(kq)[None, :, None] + 1
+    mask = jnp.arange(s)[None, None, :] < limit  # [B, K, S]
+    scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    return _gqa_out(probs, v_cache, q.dtype)

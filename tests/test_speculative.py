@@ -1,0 +1,152 @@
+"""Speculative decoding (engine/speculative.py + decode_chunk).
+
+The invariant that makes speculation safe: greedy speculative output ==
+vanilla greedy decode output token-for-token, for ANY draft model — a
+good draft only changes speed (acceptance rate), never the tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.engine.generate import generate
+from llm_consensus_tpu.engine.speculative import speculative_generate
+from llm_consensus_tpu.models.cache import KVCache
+from llm_consensus_tpu.models.configs import get_config
+from llm_consensus_tpu.models.transformer import (
+    decode_chunk,
+    decode_step,
+    init_params,
+    prefill,
+)
+
+CFG = get_config("test-tiny")
+
+
+def _params(seed):
+    return init_params(CFG, jax.random.PRNGKey(seed), dtype=jnp.float32)
+
+
+def _prompt_batch():
+    tokens = jnp.asarray(
+        [[5, 6, 7, 8, 9, 0, 0, 0], [10, 11, 12, 13, 14, 15, 16, 17]],
+        jnp.int32,
+    )
+    lengths = jnp.asarray([5, 8], jnp.int32)
+    return tokens, lengths
+
+
+# ---------------------------------------------------------------------------
+# decode_chunk: the verification op
+# ---------------------------------------------------------------------------
+
+
+def test_decode_chunk_matches_sequential_decode_steps():
+    """Chunk logits == the logits of K sequential decode_steps."""
+    params = _params(0)
+    tokens, lengths = _prompt_batch()
+    K = 3
+    chunk_tokens = jnp.asarray([[21, 22, 23], [24, 25, 26]], jnp.int32)
+
+    cache = KVCache.create(CFG, 2, 32, dtype=jnp.float32)
+    _, cache = prefill(CFG, params, tokens, lengths, cache)
+    seq_logits = []
+    c = cache
+    for i in range(K):
+        lg, c = decode_step(CFG, params, chunk_tokens[:, i : i + 1], c)
+        seq_logits.append(lg)
+    want = jnp.stack(seq_logits, axis=1)  # [B, K, V]
+
+    cache2 = KVCache.create(CFG, 2, 32, dtype=jnp.float32)
+    _, cache2 = prefill(CFG, params, tokens, lengths, cache2)
+    got, cache2 = decode_chunk(CFG, params, chunk_tokens, cache2)
+
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+    # Length is NOT advanced by the chunk itself.
+    assert cache2.length.tolist() == lengths.tolist()
+
+
+def test_decode_chunk_rejects_quant_cache():
+    from llm_consensus_tpu.models.cache import QuantKVCache
+
+    params = _params(0)
+    cache = QuantKVCache.create(CFG, 1, 16)
+    with pytest.raises(ValueError, match="bf16"):
+        decode_chunk(CFG, params, jnp.ones((1, 2), jnp.int32), cache)
+
+
+# ---------------------------------------------------------------------------
+# speculative_generate: exactness vs vanilla greedy
+# ---------------------------------------------------------------------------
+
+
+def _vanilla_greedy(params, tokens, lengths, max_new):
+    out = generate(
+        CFG,
+        params,
+        tokens,
+        lengths,
+        jax.random.PRNGKey(0),
+        jnp.zeros((tokens.shape[0],)),  # temperature 0 = greedy
+        max_new_tokens=max_new,
+        eos_id=-1,
+    )
+    return out.tokens
+
+
+@pytest.mark.parametrize("k_spec", [1, 2, 4])
+def test_speculative_equals_greedy_self_draft(k_spec):
+    """Draft == target: every draft token accepted, output identical."""
+    params = _params(0)
+    tokens, lengths = _prompt_batch()
+    want = _vanilla_greedy(params, tokens, lengths, 12)
+    out = speculative_generate(
+        CFG, params, CFG, params, tokens, lengths,
+        max_new_tokens=12, k_spec=k_spec, eos_id=-1,
+    )
+    assert out.tokens.tolist() == want.tolist()
+    assert out.num_tokens.tolist() == [12, 12]
+    # Self-draft: acceptance is total except the final round, where the
+    # max_new_tokens budget can truncate the emitted run.
+    assert int(out.accepted) > 0
+    b = tokens.shape[0]
+    assert int(out.accepted) >= int(out.drafted) - k_spec * b
+
+
+def test_speculative_equals_greedy_bad_draft():
+    """A DIFFERENT random draft: low acceptance, output still exact."""
+    params_t = _params(0)
+    params_d = _params(99)  # unrelated draft weights
+    tokens, lengths = _prompt_batch()
+    want = _vanilla_greedy(params_t, tokens, lengths, 10)
+    out = speculative_generate(
+        CFG, params_t, CFG, params_d, tokens, lengths,
+        max_new_tokens=10, k_spec=4, eos_id=-1,
+    )
+    assert out.tokens.tolist() == want.tolist()
+    assert out.num_tokens.tolist() == [10, 10]
+    # Bad draft: more rounds than the self-draft case, not more than one
+    # emitted token minimum per round.
+    assert int(out.rounds) <= 10
+
+
+def test_speculative_eos_stops_row():
+    """EOS inside an accepted run truncates that row's output."""
+    params = _params(0)
+    tokens, lengths = _prompt_batch()
+    ref = _vanilla_greedy(params, tokens, lengths, 12)
+    # Pick the token the model actually emits at step 3 of row 0 as the
+    # "EOS" so the speculative run must stop there.
+    eos = int(ref[0, 3])
+    out = speculative_generate(
+        CFG, params, CFG, params, tokens, lengths,
+        max_new_tokens=12, k_spec=4, eos_id=eos,
+    )
+    n0 = int(out.num_tokens[0])
+    assert n0 <= 4
+    assert int(out.tokens[0, n0 - 1]) == eos
+    # Tokens past EOS are pad.
+    assert all(int(t) == 0 for t in out.tokens[0, n0:])
