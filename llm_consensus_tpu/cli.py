@@ -81,6 +81,17 @@ def _build_backend(args):
             cfg.name,
         )
         params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = None
+    if args.mesh:
+        from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        sizes = {}
+        for part in args.mesh.split(","):
+            axis, _, n = part.partition("=")
+            sizes[axis.strip()] = int(n)
+        mesh = make_mesh(MeshConfig(**sizes))
+        if mesh.shape.get("seq", 1) > 1:
+            cfg = cfg.with_(use_ring=True)
     engine = InferenceEngine(
         cfg,
         params,
@@ -88,6 +99,7 @@ def _build_backend(args):
         engine_config=EngineConfig(
             max_new_tokens=args.max_new_tokens, quant=args.quant
         ),
+        mesh=mesh,
     )
     return LocalBackend(engine)
 
@@ -108,11 +120,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--quant",
-        choices=["none", "int8"],
+        choices=["none", "int8", "int4"],
         default="none",
         help="weight-only quantization for the local engine",
     )
     p.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
+    p.add_argument(
+        "--mesh",
+        default=None,
+        metavar="AXIS=N[,AXIS=N...]",
+        help="shard the local engine over a device mesh, e.g. "
+        "'data=4,model=2' (axes: data/model/expert/seq/pipe; product "
+        "must equal the device count; seq>1 enables ring attention)",
+    )
     p.add_argument("--panel", default=None, help="panel JSON file")
     p.add_argument(
         "--max-rounds",
@@ -130,8 +150,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--eval-gsm8k",
         default=None,
-        metavar="JSONL|synthetic",
-        help="run the GSM8K EM harness on a JSONL file or 'synthetic'",
+        metavar="JSONL|bundled|synthetic",
+        help="run the GSM8K EM harness on a JSONL file, the bundled "
+        "50-problem dataset (eval/data/gsm8k_mini.jsonl), or 'synthetic'",
     )
     p.add_argument("--eval-n", type=int, default=8, help="candidates per problem")
     p.add_argument("--eval-limit", type=int, default=20)
@@ -203,6 +224,13 @@ def _run_eval(args) -> int:
     backend = _build_backend(args)
     if args.eval_gsm8k == "synthetic":
         problems = synthetic_problems(args.eval_limit)
+    elif args.eval_gsm8k == "bundled":
+        import llm_consensus_tpu.eval as _eval_pkg
+
+        bundled = os.path.join(
+            os.path.dirname(_eval_pkg.__file__), "data", "gsm8k_mini.jsonl"
+        )
+        problems = load_gsm8k(bundled, limit=args.eval_limit)
     else:
         problems = load_gsm8k(args.eval_gsm8k, limit=args.eval_limit)
     report = evaluate_self_consistency(

@@ -97,3 +97,44 @@ def test_repl_loop_exit(monkeypatch, capsys):
     out = capsys.readouterr().out
     assert out.count("Enter a question: ") == 2
     assert "What is up?" in out
+
+
+def test_eval_bundled_dataset_with_local_backend(capsys):
+    """--eval-gsm8k bundled runs the harness on the packaged dataset
+    through a (random-weight) local engine, emitting the JSON report."""
+    import json
+
+    from llm_consensus_tpu.cli import main
+
+    rc = main(
+        [
+            "--backend", "local",
+            "--model", "test-tiny",
+            "--eval-gsm8k", "bundled",
+            "--eval-n", "2",
+            "--eval-limit", "2",
+            "--max-new-tokens", "4",
+        ]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["n_problems"] == 2
+    assert report["n_candidates"] == 2
+
+
+def test_cli_mesh_flag_shards_engine(capsys):
+    """--mesh data=8 answers a one-shot question on a sharded engine."""
+    from llm_consensus_tpu.cli import main
+
+    rc = main(
+        [
+            "--backend", "local",
+            "--model", "test-tiny",
+            "--mesh", "data=8",
+            "--question", "What is 2+2?",
+            "--max-new-tokens", "4",
+            "--max-rounds", "1",
+        ]
+    )
+    assert rc == 0
+    assert capsys.readouterr().out.strip()
