@@ -44,8 +44,9 @@ def main() -> int:
     p.add_argument(
         "--quant",
         default="int8",
-        choices=("none", "int8"),
-        help="weight-only quantization (int8 halves decode HBM traffic)",
+        choices=("none", "int8", "int4"),
+        help="weight-only quantization (int8 halves decode HBM traffic; "
+        "int4 packed nibbles halve it again)",
     )
     p.add_argument(
         "--kv-quant",
@@ -92,10 +93,12 @@ def main() -> int:
     )
 
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
-    if args.quant == "int8":
+    if args.quant in ("int8", "int4"):
         from llm_consensus_tpu.ops.quant import quantize_params
 
-        params = quantize_params(params)
+        params = quantize_params(
+            params, bits=8 if args.quant == "int8" else 4
+        )
     b, s = args.n_candidates, args.prompt_len
     tokens = jnp.ones((b, s), jnp.int32)
     lengths = jnp.full((b,), s, jnp.int32)
