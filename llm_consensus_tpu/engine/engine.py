@@ -74,6 +74,7 @@ class InferenceEngine:
         tokenizer: Tokenizer | None = None,
         engine_config: EngineConfig | None = None,
         mesh=None,
+        draft: tuple[ModelConfig, dict] | None = None,
     ):
         self.cfg = cfg
         self.params = params
@@ -92,6 +93,9 @@ class InferenceEngine:
             )
         elif self.config.quant != "none":
             raise ValueError(f"unknown quant mode {self.config.quant!r}")
+        # Optional draft model for generate_texts_speculative: a
+        # (config, params) pair sharing this model's tokenizer/vocab.
+        self.draft = draft
         self.mesh = mesh
         self._data_sharding = None
         if mesh is not None:
@@ -244,6 +248,72 @@ class InferenceEngine:
                     text=self.tokenizer.decode(ids),
                     num_tokens=n,
                     logprob=float(lps[i]),
+                    token_ids=ids,
+                )
+            )
+        return results
+
+    def generate_texts_speculative(
+        self,
+        prompts: list[str],
+        max_new_tokens: int | None = None,
+        k_spec: int = 4,
+    ) -> list[EngineResult]:
+        """Greedy generation accelerated by the draft model.
+
+        Requires ``draft=(cfg, params)`` at engine construction. Output
+        text is IDENTICAL to greedy ``generate_texts`` (speculation only
+        changes speed — tested); greedy-only, single-device, no
+        logprobs (reported as 0.0).
+        """
+        if self.draft is None:
+            raise ValueError("engine was built without a draft model")
+        if not prompts:
+            return []
+        chunk = self.config.batch_buckets[-1]
+        if len(prompts) > chunk:
+            out: list[EngineResult] = []
+            for i in range(0, len(prompts), chunk):
+                out.extend(
+                    self.generate_texts_speculative(
+                        prompts[i : i + chunk],
+                        max_new_tokens=max_new_tokens,
+                        k_spec=k_spec,
+                    )
+                )
+            return out
+        from llm_consensus_tpu.engine.speculative import speculative_generate
+
+        draft_cfg, draft_params = self.draft
+        tokens, lengths, n_real = self._prepare(prompts)
+        # Same clamp as generate_texts — the k_spec+1 chunk slack lives
+        # in speculative_generate's cache_len, NOT in the token budget,
+        # so outputs stay identical to the greedy path.
+        mnt = max_new_tokens or self.config.max_new_tokens
+        mnt = max(1, min(mnt, self.cfg.max_seq_len - tokens.shape[1]))
+        out = speculative_generate(
+            self.cfg,
+            self.params,
+            draft_cfg,
+            draft_params,
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            max_new_tokens=mnt,
+            k_spec=k_spec,
+            eos_id=self.tokenizer.eos_id,
+            pad_id=self.tokenizer.pad_id,
+        )
+        toks = np.asarray(out.tokens)
+        nums = np.asarray(out.num_tokens)
+        results = []
+        for i in range(n_real):
+            n = int(nums[i])
+            ids = [int(t) for t in toks[i, :n] if t != self.tokenizer.eos_id]
+            results.append(
+                EngineResult(
+                    text=self.tokenizer.decode(ids),
+                    num_tokens=n,
+                    logprob=0.0,
                     token_ids=ids,
                 )
             )

@@ -13,12 +13,20 @@ The ragged KV-cache design makes rollback free: acceptance only sets
 masked-out garbage past the fill and are overwritten later, exactly
 like prefill padding.
 
-v1 scope: greedy only (temperature 0), bf16 caches. The key invariant —
-tested in tests/test_speculative.py — is EXACTNESS: output tokens equal
-vanilla greedy decode token-for-token for ANY draft model; the draft
-only affects speed. (Sampled speculative decoding needs the
-accept-with-prob-p(t)/p(d) residual scheme; the verification chunk op
-and cache plumbing here are the hard part and are sampling-agnostic.)
+Two modes, both exactness-anchored (tests/test_speculative.py):
+
+- **Greedy** (no ``temperature``): accept while draft == target argmax.
+  Output tokens equal vanilla greedy decode token-for-token for ANY
+  draft model; the draft only affects speed.
+- **Sampled** (``temperature`` + ``key``): Leviathan et al. acceptance —
+  accept d with prob min(1, p(d)/q(d)), else resample from the residual
+  ``norm(max(p - q, 0))`` (:func:`leviathan_accept`, whose marginal is
+  EXACTLY the target distribution — Monte-Carlo-verified). Plain
+  temperature scaling; top-k/top-p do not compose with the acceptance
+  identity and are not applied here.
+
+bf16 KV caches only (the verification chunk writes ragged per-row
+positions; the int8 head-major scatter isn't worth it on this path).
 
 The reference has no decoding at all to speed up (remote API,
 ``src/main.rs:82-86``); this is the TPU build's own perf work past
@@ -40,6 +48,37 @@ from llm_consensus_tpu.models.transformer import (
     decode_step,
     prefill,
 )
+
+
+_EPS = 1e-20
+
+
+def leviathan_accept(
+    p: jnp.ndarray,
+    q: jnp.ndarray,
+    draft: jnp.ndarray,
+    key: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One Leviathan et al. acceptance decision (pure, testable).
+
+    p: [V] target probs; q: [V] draft probs; draft: scalar token drawn
+    from q. Accept with prob min(1, p[d]/q[d]); on rejection the caller
+    replaces the token with one drawn from the residual
+    ``norm(max(p - q, 0))``. Marginal over (draft, coin, correction) is
+    EXACTLY p — verified by Monte Carlo in tests/test_speculative.py.
+
+    Returns (accept bool, correction token int32).
+    """
+    k_coin, k_corr = jax.random.split(key)
+    ratio = p[draft] / jnp.maximum(q[draft], _EPS)
+    accept = jax.random.uniform(k_coin) < ratio
+    resid = jnp.maximum(p - q, 0.0)
+    total = jnp.sum(resid)
+    # Identical distributions -> empty residual; rejection then has
+    # probability 0, so any valid fallback distribution works.
+    resid = jnp.where(total > _EPS, resid / jnp.maximum(total, _EPS), p)
+    corr = jax.random.categorical(k_corr, jnp.log(jnp.maximum(resid, _EPS)))
+    return accept, corr.astype(jnp.int32)
 
 
 @jax.tree_util.register_dataclass
@@ -77,6 +116,8 @@ def speculative_generate(
     eos_id: int = 2,
     pad_id: int = 0,
     cache_len: int | None = None,
+    temperature: jnp.ndarray | None = None,
+    key: jax.Array | None = None,
 ) -> SpecOutput:
     """Greedy speculative decode of right-padded prompts.
 
@@ -90,6 +131,13 @@ def speculative_generate(
     round emits >= 1 token, so at most ``max_new_tokens`` rounds run
     (the while_loop is data-dependent — decode stops as soon as every
     row is done).
+
+    ``temperature`` ([B], with ``key``) switches to SAMPLED speculative
+    decoding: drafts are drawn from the draft's temperature-scaled
+    distribution and verified with :func:`leviathan_accept`, whose
+    marginal equals direct target sampling exactly. Rows with
+    temperature 0 take the greedy accept rule. Plain temperature
+    sampling only (no top-k/top-p composition).
     """
     b, s = tokens.shape
     if cache_len is None:
@@ -98,13 +146,32 @@ def speculative_generate(
     if cache_len < s + max_new_tokens + k_spec + 1:
         raise ValueError(f"cache_len {cache_len} too small")
 
+    sampled = temperature is not None
+    if sampled and key is None:
+        raise ValueError("sampled speculative decoding needs a PRNG key")
+    if sampled:
+        temperature = jnp.asarray(temperature, jnp.float32)
+        t_eff = jnp.maximum(temperature, 1e-6)[:, None]  # [B, 1]
+        greedy_row = (temperature <= 0.0)[:, None]  # [B, 1]
+
     cache_t = KVCache.create(cfg_t, b, cache_len)
     logits_t, cache_t = prefill(cfg_t, params_t, tokens, lengths, cache_t)
     cache_d = KVCache.create(cfg_d, b, cache_len)
     _, cache_d = prefill(cfg_d, params_d, tokens, lengths, cache_d)
 
+    def _pick(logits2d, k):
+        """Per-row token from [B, V] logits: sampled or greedy."""
+        greedy = jnp.argmax(logits2d, axis=-1).astype(jnp.int32)
+        if not sampled:
+            return greedy
+        drawn = jax.random.categorical(k, logits2d / t_eff, axis=-1)
+        return jnp.where(
+            greedy_row[:, 0], greedy, drawn.astype(jnp.int32)
+        )
+
     # First token comes from the target's prefill logits directly.
-    tok0 = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # [B]
+    k0 = jax.random.fold_in(key, 0) if sampled else None
+    tok0 = _pick(logits_t, k0)  # [B]
     out0 = jnp.full((b, max_new_tokens), pad_id, jnp.int32)
     out0 = out0.at[:, 0].set(tok0)
     n0 = jnp.ones((b,), jnp.int32)
@@ -122,15 +189,22 @@ def speculative_generate(
         len_t0 = cache_t.length
         len_d0 = cache_d.length
 
-        # --- Draft proposes k_spec greedy tokens -----------------------
-        def dstep(carry, _):
+        rkey = jax.random.fold_in(key, rounds + 1) if sampled else None
+
+        # --- Draft proposes k_spec tokens ------------------------------
+        def dstep(carry, i):
             x, cd = carry
             lg, cd = decode_step(cfg_d, params_d, x[:, None], cd)
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return (nxt, cd), nxt
+            if sampled:
+                nxt = _pick(lg, jax.random.fold_in(rkey, i))
+                qp = jax.nn.softmax(lg / t_eff, axis=-1)  # [B, V]
+            else:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                qp = jnp.zeros((b, 1), jnp.float32)  # unused
+            return (nxt, cd), (nxt, qp)
 
-        (_, cache_d), drafts = jax.lax.scan(
-            dstep, (tok, cache_d), None, length=k_spec
+        (_, cache_d), (drafts, q_probs) = jax.lax.scan(
+            dstep, (tok, cache_d), jnp.arange(k_spec)
         )
         drafts = drafts.T  # [B, K]
         # One extra draft step consuming d_{K-1}: on full acceptance the
@@ -140,26 +214,60 @@ def speculative_generate(
 
         # --- Target verifies the whole draft in one chunk --------------
         # Chunk inputs: [tok, d_0 .. d_{K-1}] (K+1); logits_j predicts
-        # the token after consuming input j, so g_j verifies d_j for
-        # j < K, and g_K is the FREE bonus token after a fully accepted
-        # draft (Leviathan et al.) — k_spec+1 tokens from one target
-        # forward.
+        # the token after consuming input j, so position j verifies d_j
+        # for j < K, and position K yields the FREE bonus token after a
+        # fully accepted draft (Leviathan et al.) — k_spec+1 tokens from
+        # one target forward.
         chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
         logits, cache_t = decode_chunk(cfg_t, params_t, chunk, cache_t)
         targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
 
-        match = drafts == targets[:, :k_spec]  # [B, K]
+        greedy_match = drafts == targets[:, :k_spec]  # [B, K]
+        if sampled:
+            # q_probs: [K, B, V] -> [B, K, V]; p_probs: [B, K+1, V].
+            # Position K (the bonus slot) carries zero draft mass: its
+            # leviathan_accept residual is then exactly the target
+            # distribution, so ONE vmapped call of the tested helper
+            # yields both the K acceptance coins and every candidate
+            # correction/bonus token.
+            q_probs = q_probs.transpose(1, 0, 2)
+            p_probs = jax.nn.softmax(logits / t_eff[:, :, None], axis=-1)
+            q_pad = jnp.concatenate(
+                [q_probs, jnp.zeros_like(q_probs[:, :1])], axis=1
+            )  # [B, K+1, V]
+            d_pad = jnp.pad(drafts, ((0, 0), (0, 1)))  # [B, K+1]
+            flat_keys = jax.random.split(
+                jax.random.fold_in(rkey, 1000), b * (k_spec + 1)
+            )
+            keys = flat_keys.reshape((b, k_spec + 1) + flat_keys.shape[1:])
+            coin, corr = jax.vmap(jax.vmap(leviathan_accept))(
+                p_probs, q_pad, d_pad, keys
+            )
+            match = jnp.where(greedy_row, greedy_match, coin[:, :k_spec])
+        else:
+            match = greedy_match
         acc_mask = jnp.cumprod(match.astype(jnp.int32), axis=1)  # [B, K]
         n_acc = jnp.sum(acc_mask, axis=1)  # [B] in [0, K]
 
-        # Emitted this round: accepted drafts, then the target token at
-        # position n_acc — the correction on a mismatch, the bonus on
-        # full acceptance. Uniformly n_acc + 1 tokens.
+        fix_greedy = jnp.take_along_axis(targets, n_acc[:, None], axis=1)[
+            :, 0
+        ]
+        if sampled:
+            fix_sampled = jnp.take_along_axis(corr, n_acc[:, None], axis=1)[
+                :, 0
+            ]
+            fix = jnp.where(greedy_row[:, 0], fix_greedy, fix_sampled)
+        else:
+            fix = fix_greedy
+
+        # Emitted this round: accepted drafts, then ``fix`` at position
+        # n_acc — the correction on a rejection, the bonus on full
+        # acceptance. Uniformly n_acc + 1 tokens.
         j = jnp.arange(k_spec + 1)[None, :]
         emit = jnp.where(
             j < n_acc[:, None],
             jnp.pad(drafts, ((0, 0), (0, 1))),
-            jnp.where(j == n_acc[:, None], targets, pad_id),
+            jnp.where(j == n_acc[:, None], fix[:, None], pad_id),
         )  # [B, K+1]
         emit_cnt = n_acc + 1  # [B]
 

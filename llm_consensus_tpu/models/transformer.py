@@ -637,6 +637,48 @@ def decode_chunk(
     return logits, cache
 
 
+def prefill_chunked(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    cache: KVCache,
+    chunk: int = 512,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill in fixed-size chunks — bounded activation memory.
+
+    One-shot :func:`prefill` materializes activations for the whole
+    [B, S] prompt at once; for long contexts this chunks the prompt into
+    ``ceil(S / chunk)`` :func:`decode_chunk` passes (each chunk attends
+    the cache so far plus itself — same ragged-causal rule), keeping
+    peak activation memory at O(B * chunk) while writing the identical
+    cache. Returns (last-valid-token logits [B, V] fp32, cache with
+    length = ``lengths``) — same contract as :func:`prefill`, and
+    exactness-tested against it.
+    """
+    b, s = tokens.shape
+    if s % chunk:
+        pad = chunk - s % chunk
+        tokens = jnp.pad(tokens, ((0, 0), (0, pad)))
+        s += pad
+    cache = cache.with_length(jnp.zeros((b,), jnp.int32))
+    last = jnp.clip(lengths - 1, 0, s - 1)
+    batch = jnp.arange(b)
+    out = jnp.zeros((b, cfg.vocab_size), jnp.float32)
+    for c0 in range(0, s, chunk):
+        logits_c, cache = decode_chunk(
+            cfg, params, tokens[:, c0 : c0 + chunk], cache
+        )
+        cache = cache.with_length(cache.length + chunk)
+        # Keep only each row's last-valid-token logits (a [B, chunk, V]
+        # buffer per chunk — never [B, S, V]).
+        in_chunk = (last >= c0) & (last < c0 + chunk)
+        got = logits_c[batch, jnp.clip(last - c0, 0, chunk - 1)]
+        out = jnp.where(in_chunk[:, None], got, out)
+    cache = cache.with_length(lengths)
+    return out, cache
+
+
 def decode_step(
     cfg: ModelConfig,
     params: dict,

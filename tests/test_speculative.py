@@ -150,3 +150,172 @@ def test_speculative_eos_stops_row():
     assert int(out.tokens[0, n0 - 1]) == eos
     # Tokens past EOS are pad.
     assert all(int(t) == 0 for t in out.tokens[0, n0:])
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill (bounded-memory long-context prefill over decode_chunk)
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_chunked_matches_prefill():
+    from llm_consensus_tpu.models.transformer import prefill_chunked
+
+    params = _params(0)
+    tokens, lengths = _prompt_batch()
+
+    cache_a = KVCache.create(CFG, 2, 32, dtype=jnp.float32)
+    logits_a, cache_a = prefill(CFG, params, tokens, lengths, cache_a)
+    cache_b = KVCache.create(CFG, 2, 32, dtype=jnp.float32)
+    logits_b, cache_b = prefill_chunked(
+        CFG, params, tokens, lengths, cache_b, chunk=3
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(logits_b), np.asarray(logits_a), rtol=2e-4, atol=2e-4
+    )
+    assert cache_b.length.tolist() == cache_a.length.tolist()
+    # Cache contents agree on every VALID slot (garbage differs on pads).
+    for row, n in enumerate(lengths.tolist()):
+        np.testing.assert_allclose(
+            np.asarray(cache_b.k[:, row, :n]),
+            np.asarray(cache_a.k[:, row, :n]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_prefill_chunked_then_decode_matches():
+    """Greedy decode from a chunk-prefilled cache == from one-shot."""
+    from llm_consensus_tpu.models.transformer import prefill_chunked
+
+    params = _params(0)
+    tokens, lengths = _prompt_batch()
+
+    cache_a = KVCache.create(CFG, 2, 32, dtype=jnp.float32)
+    logits_a, cache_a = prefill(CFG, params, tokens, lengths, cache_a)
+    cache_b = KVCache.create(CFG, 2, 32, dtype=jnp.float32)
+    logits_b, cache_b = prefill_chunked(
+        CFG, params, tokens, lengths, cache_b, chunk=5
+    )
+    ta = jnp.argmax(logits_a, -1).astype(jnp.int32)
+    tb = jnp.argmax(logits_b, -1).astype(jnp.int32)
+    assert ta.tolist() == tb.tolist()
+    for _ in range(4):
+        la, cache_a = decode_step(CFG, params, ta[:, None], cache_a)
+        lb, cache_b = decode_step(CFG, params, tb[:, None], cache_b)
+        ta = jnp.argmax(la, -1).astype(jnp.int32)
+        tb = jnp.argmax(lb, -1).astype(jnp.int32)
+        assert ta.tolist() == tb.tolist()
+
+
+def test_engine_speculative_matches_engine_greedy():
+    """Engine-level speculative texts == engine greedy texts."""
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    params_t = _params(0)
+    params_d = _params(5)
+    ecfg = EngineConfig(
+        max_new_tokens=8, seq_buckets=(16,), batch_buckets=(1, 2)
+    )
+    eng = InferenceEngine(
+        CFG, params_t, engine_config=ecfg, draft=(CFG, params_d)
+    )
+    prompts = ["the quick brown", "hello there"]
+    want = [r.text for r in eng.generate_texts(prompts)]  # temp 0 greedy
+    got = [r.text for r in eng.generate_texts_speculative(prompts)]
+    assert got == want
+
+
+def test_engine_speculative_requires_draft():
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        CFG, _params(0),
+        engine_config=EngineConfig(seq_buckets=(16,), batch_buckets=(1,)),
+    )
+    with pytest.raises(ValueError, match="draft"):
+        eng.generate_texts_speculative(["x"])
+
+
+# ---------------------------------------------------------------------------
+# Sampled speculative decoding (Leviathan acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_leviathan_accept_marginal_equals_target():
+    """Monte Carlo: draft-sample -> accept/residual has marginal p."""
+    from llm_consensus_tpu.engine.speculative import leviathan_accept
+
+    p = jnp.asarray([0.4, 0.1, 0.05, 0.2, 0.05, 0.1, 0.05, 0.05])
+    q = jnp.asarray([0.1, 0.4, 0.05, 0.05, 0.2, 0.05, 0.1, 0.05])
+    n = 40000
+
+    def one(k):
+        kd, ka = jax.random.split(k)
+        d = jax.random.categorical(kd, jnp.log(q))
+        accept, corr = leviathan_accept(p, q, d, ka)
+        return jnp.where(accept, d, corr)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    outs = jax.jit(jax.vmap(one))(keys)
+    freq = jnp.bincount(outs, length=8) / n
+    # 3-sigma binomial bound per bucket (max p=0.4 -> sigma ~ 0.0024).
+    np.testing.assert_allclose(
+        np.asarray(freq), np.asarray(p), atol=0.01
+    )
+
+
+def test_sampled_speculative_greedy_limit_exact():
+    """temperature=0 rows through the sampled path == greedy output."""
+    params_t = _params(0)
+    params_d = _params(99)
+    tokens, lengths = _prompt_batch()
+    want = _vanilla_greedy(params_t, tokens, lengths, 10)
+    out = speculative_generate(
+        CFG, params_t, CFG, params_d, tokens, lengths,
+        max_new_tokens=10, k_spec=3, eos_id=-1,
+        temperature=jnp.zeros((2,)), key=jax.random.PRNGKey(4),
+    )
+    assert out.tokens.tolist() == want.tolist()
+
+
+def test_sampled_speculative_smoke_and_variety():
+    """temperature=1: rows fill their budget; different keys differ."""
+    params_t = _params(0)
+    params_d = _params(1)
+    tokens, lengths = _prompt_batch()
+    outs = []
+    for seed in (0, 1, 2):
+        out = speculative_generate(
+            CFG, params_t, CFG, params_d, tokens, lengths,
+            max_new_tokens=8, k_spec=3, eos_id=-1,
+            temperature=jnp.full((2,), 1.0), key=jax.random.PRNGKey(seed),
+        )
+        assert out.num_tokens.tolist() == [8, 8]
+        outs.append(tuple(map(tuple, out.tokens.tolist())))
+    assert len(set(outs)) > 1  # sampling actually varies by key
+
+
+def test_sampled_speculative_requires_key():
+    params = _params(0)
+    tokens, lengths = _prompt_batch()
+    with pytest.raises(ValueError, match="PRNG key"):
+        speculative_generate(
+            CFG, params, CFG, params, tokens, lengths,
+            max_new_tokens=4, temperature=jnp.ones((2,)),
+        )
+
+
+def test_engine_speculative_chunks_past_batch_bucket():
+    """More prompts than the largest batch bucket run as chunks."""
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        CFG, _params(0),
+        engine_config=EngineConfig(
+            max_new_tokens=3, seq_buckets=(16,), batch_buckets=(1, 2)
+        ),
+        draft=(CFG, _params(5)),
+    )
+    results = eng.generate_texts_speculative([f"q{i}" for i in range(5)])
+    assert len(results) == 5
+    assert all(r.num_tokens >= 1 for r in results)
