@@ -218,6 +218,33 @@ def heterogeneous_panel_vote(
     )
 
 
+def _device_vote(engine, texts: list[str], key_fn) -> VoteResult:
+    """North-star reducer end-to-end: canonicalize on host, tally on the
+    engine's mesh (one-hot psum over the ``data`` axis + argmax — the
+    vote rides ICI instead of a host gather). Requires a mesh-wired
+    engine; candidates pad to the data-axis size with zero-weight votes.
+    """
+    mesh = engine.mesh
+    # First-seen class order, so argmax's lowest-index tie-break picks
+    # the same winner as the host vote's insertion-ordered max().
+    keys = [key_fn(t) for t in texts]
+    classes = list(dict.fromkeys(keys))
+    ids = [classes.index(k) for k in keys]
+    dp = int(mesh.shape.get("data", 1))
+    pad = (-len(ids)) % dp
+    weights = jnp.asarray([1.0] * len(ids) + [0.0] * pad, jnp.float32)
+    ids_arr = jnp.asarray(ids + [0] * pad, jnp.int32)
+    winner_id, hist = device_majority_vote(
+        ids_arr, len(classes), mesh, weights=weights
+    )
+    winner = classes[winner_id]
+    rep = next(t for t, k in zip(texts, keys) if k == winner)
+    tally = {c: float(hist[i]) for i, c in enumerate(classes)}
+    return VoteResult(
+        winner=winner, text=rep, tally=tally, n_candidates=len(texts)
+    )
+
+
 @dataclass
 class SelfConsistencyResult:
     vote: VoteResult
@@ -238,8 +265,14 @@ def self_consistency(
 ) -> SelfConsistencyResult:
     """N-way self-consistency: ONE batched sample of n candidates on the
     engine (the candidate axis is the mesh ``data`` axis when sharded),
-    then vote. ``method``: majority | logit_pool.
+    then vote. ``method``: majority | logit_pool | device_majority (the
+    on-device psum+argmax reducer; needs a mesh-wired engine).
     """
+    if method not in ("majority", "logit_pool", "device_majority"):
+        raise ValueError(f"unknown aggregation method {method!r}")
+    if method == "device_majority" and getattr(engine, "mesh", None) is None:
+        # Fail before the expensive N-way generation, not after.
+        raise ValueError("device_majority needs a mesh-wired engine")
     results = engine.generate_texts(
         [prompt] * n,
         temperatures=[temperature] * n,
@@ -253,7 +286,7 @@ def self_consistency(
     elif method == "logit_pool":
         vote = logit_pool(texts, lps, key_fn)
     else:
-        raise ValueError(f"unknown aggregation method {method!r}")
+        vote = _device_vote(engine, texts, key_fn)
     return SelfConsistencyResult(
         vote=vote,
         candidates=texts,

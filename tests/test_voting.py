@@ -16,6 +16,7 @@ from llm_consensus_tpu.consensus.voting import (
     extract_final_number,
     logit_pool,
     majority_vote,
+    self_consistency,
     weighted_vote,
 )
 from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -187,3 +188,63 @@ def test_self_consistency_end_to_end():
     assert out2.vote.n_candidates == 8
     with pytest.raises(ValueError):
         self_consistency(eng, "q", n=2, method="bogus")
+
+
+def test_self_consistency_device_majority_matches_host():
+    """method='device_majority' on a mesh-wired engine: the on-device
+    psum+argmax tally picks the same winner as the host vote."""
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = make_mesh(MeshConfig(data=8))
+    ecfg = EngineConfig(
+        max_new_tokens=5, seq_buckets=(16,), batch_buckets=(8, 16)
+    )
+    eng = InferenceEngine(cfg, params, engine_config=ecfg, mesh=mesh)
+
+    host = self_consistency(eng, "2+2?", n=16, temperature=0.9, seed=2)
+    dev = self_consistency(
+        eng, "2+2?", n=16, temperature=0.9, seed=2,
+        method="device_majority",
+    )
+    assert dev.vote.winner == host.vote.winner
+    assert dev.vote.n_candidates == 16
+    # Tallies agree as multisets of counts.
+    assert sorted(dev.vote.tally.values()) == sorted(
+        host.vote.tally.values()
+    )
+
+
+def test_device_majority_requires_mesh_engine():
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config("test-tiny")
+    eng = InferenceEngine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32),
+        engine_config=EngineConfig(seq_buckets=(16,), batch_buckets=(1,)),
+    )
+    with pytest.raises(ValueError, match="mesh"):
+        self_consistency(
+            eng, "x", n=1, max_new_tokens=2, method="device_majority"
+        )
+
+
+def test_device_vote_tie_breaks_like_host_vote():
+    """On a tied tally both reducers pick the first-seen answer."""
+    from types import SimpleNamespace
+
+    from llm_consensus_tpu.consensus.voting import _device_vote
+
+    mesh = make_mesh(MeshConfig(data=8))
+    eng = SimpleNamespace(mesh=mesh)
+    texts = ["banana", "apple", "banana", "apple"]
+    host = majority_vote(texts)
+    dev = _device_vote(eng, texts, canonicalize)
+    assert dev.winner == host.winner == "banana"
