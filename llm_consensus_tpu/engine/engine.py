@@ -47,6 +47,10 @@ class EngineConfig:
     # int8 KV cache (models/cache.QuantKVCache): halves cache HBM
     # traffic per decode step (the dominant term at large N).
     kv_quant: bool = False
+    # > 0: prefill prompts longer than this in fixed-size chunks
+    # (models/transformer.prefill_chunked) — bounded activation memory
+    # for long contexts; bf16 cache only.
+    prefill_chunk: int = 0
 
 
 @dataclass
@@ -93,6 +97,12 @@ class InferenceEngine:
             )
         elif self.config.quant != "none":
             raise ValueError(f"unknown quant mode {self.config.quant!r}")
+        if self.config.prefill_chunk > 0 and self.config.kv_quant:
+            # Silent one-shot fallback would unbound exactly the memory
+            # prefill_chunk exists to bound; surface the conflict now.
+            raise ValueError(
+                "prefill_chunk requires the bf16 KV cache (kv_quant=False)"
+            )
         # Optional draft model for generate_texts_speculative: a
         # (config, params) pair sharing this model's tokenizer/vocab.
         self.draft = draft
@@ -234,6 +244,7 @@ class InferenceEngine:
             # Ring prefill (long-context sequence parallelism) when the
             # model opts in and the mesh has a seq axis.
             mesh=self.mesh if self.cfg.use_ring else None,
+            prefill_chunk=self.config.prefill_chunk,
         )
         toks = np.asarray(out.tokens)
         nums = np.asarray(out.num_tokens)

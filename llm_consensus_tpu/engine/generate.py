@@ -25,7 +25,11 @@ import jax.numpy as jnp
 from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
 from llm_consensus_tpu.models.cache import KVCache, QuantKVCache
 from llm_consensus_tpu.models.configs import ModelConfig
-from llm_consensus_tpu.models.transformer import decode_step, prefill
+from llm_consensus_tpu.models.transformer import (
+    decode_step,
+    prefill,
+    prefill_chunked,
+)
 
 
 def _broadcast_cache(cache1, b: int):
@@ -69,6 +73,7 @@ class GenerateOutput:
         "shared_prefill",
         "kv_quant",
         "mesh",  # hashable; trace-time constant for the ring routing
+        "prefill_chunk",
     ),
 )
 def generate(
@@ -87,6 +92,7 @@ def generate(
     shared_prefill: bool = False,
     kv_quant: bool = False,
     mesh=None,
+    prefill_chunk: int = 0,
 ) -> GenerateOutput:
     """Generate up to ``max_new_tokens`` for a batch of right-padded prompts.
 
@@ -103,19 +109,37 @@ def generate(
         )
 
     make_cache = QuantKVCache.create if kv_quant else KVCache.create
+
+    def _prefill(p_tokens, p_lengths, p_cache):
+        # Chunked prefill (bounded activation memory for long prompts)
+        # applies on the bf16 cache when the prompt exceeds the chunk;
+        # it is exactness-tested against the one-shot path. A seq-mesh
+        # (ring attention) takes precedence: the ring IS the long-
+        # context memory strategy there, and the chunk pass has no
+        # sequence-parallel path.
+        if (
+            prefill_chunk > 0
+            and p_tokens.shape[1] > prefill_chunk
+            and not kv_quant
+            and mesh is None
+        ):
+            return prefill_chunked(
+                cfg, params, p_tokens, p_lengths, p_cache,
+                chunk=prefill_chunk,
+            )
+        return prefill(cfg, params, p_tokens, p_lengths, p_cache, mesh=mesh)
+
     if shared_prefill:
         # Self-consistency fan-out: all B rows decode from the SAME
         # prompt, so prefill once at B=1 and broadcast the cache — saves
         # (B-1)/B of the prefill FLOPs (BASELINE.json's N-way configs).
         cache1 = make_cache(cfg, 1, cache_len)
-        logits1, cache1 = prefill(
-            cfg, params, tokens[:1], lengths[:1], cache1, mesh=mesh
-        )
+        logits1, cache1 = _prefill(tokens[:1], lengths[:1], cache1)
         logits = jnp.broadcast_to(logits1, (b, logits1.shape[-1]))
         cache = _broadcast_cache(cache1, b)
     else:
         cache = make_cache(cfg, b, cache_len)
-        logits, cache = prefill(cfg, params, tokens, lengths, cache, mesh=mesh)
+        logits, cache = _prefill(tokens, lengths, cache)
 
     key0 = jax.random.fold_in(key, 0)
     tok0, lp0 = sample_token(logits, key0, temperature, sampler)

@@ -343,7 +343,9 @@ def _block(
         new_k = k_l.at[batch_idx, pos].set(k.astype(k_l.dtype))
         new_v = v_l.at[batch_idx, pos].set(v.astype(v_l.dtype))
         new_kv = (new_k, new_v)
-        attn = chunk_decode_attention(q, new_k, new_v, valid_len)
+        attn = chunk_decode_attention(
+            q, new_k, new_v, valid_len, window=cfg.sliding_window
+        )
     elif mode == "decode":
         b = x.shape[0]
         batch_idx = jnp.arange(b)
@@ -621,11 +623,27 @@ def decode_chunk(
     many chunk tokens were actually consumed (accepted) and sets the
     length via ``cache.with_length`` — rejected tokens' k/v stay as
     masked-out garbage past the fill, exactly like prefill padding.
+    Sliding-window configs (Mistral) mask per the same rule as
+    :func:`llm_consensus_tpu.ops.attention.decode_attention`.
     """
-    if cfg.sliding_window:
-        raise NotImplementedError("chunk decode with sliding window")
-    x = params["embed"][tokens]  # [B, K, D]
+    x, cache = _chunk_hidden(cfg, params, tokens, cache)
+    logits = _unembed(cfg, params, x)  # [B, K, V]
+    return logits, cache
+
+
+def _chunk_hidden(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """The chunk forward without the unembed: ([B, K, D] hidden, cache).
+
+    Callers that need only a few positions' logits (chunked prefill
+    keeps one per row) gather from the hidden states and unembed those
+    — skipping the B*K*V logits matmul per chunk."""
     kq = tokens.shape[1]
+    x = params["embed"][tokens]  # [B, K, D]
     positions = cache.length[:, None] + jnp.arange(kq)[None, :]
     cos, sin = rope_cos_sin(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
@@ -633,8 +651,7 @@ def decode_chunk(
     x, cache = _run_layers(
         cfg, params, x, cos, sin, cache, "chunk", cache.length, None
     )
-    logits = _unembed(cfg, params, x)  # [B, K, V]
-    return logits, cache
+    return x, cache
 
 
 def prefill_chunked(
@@ -664,19 +681,20 @@ def prefill_chunked(
     cache = cache.with_length(jnp.zeros((b,), jnp.int32))
     last = jnp.clip(lengths - 1, 0, s - 1)
     batch = jnp.arange(b)
-    out = jnp.zeros((b, cfg.vocab_size), jnp.float32)
+    x_last = jnp.zeros((b, cfg.d_model), jnp.float32)
     for c0 in range(0, s, chunk):
-        logits_c, cache = decode_chunk(
+        hidden, cache = _chunk_hidden(
             cfg, params, tokens[:, c0 : c0 + chunk], cache
         )
         cache = cache.with_length(cache.length + chunk)
-        # Keep only each row's last-valid-token logits (a [B, chunk, V]
-        # buffer per chunk — never [B, S, V]).
+        # Keep only each row's last-valid hidden state; the unembed (a
+        # B*V matmul, not B*chunk*V) happens ONCE after the loop.
         in_chunk = (last >= c0) & (last < c0 + chunk)
-        got = logits_c[batch, jnp.clip(last - c0, 0, chunk - 1)]
-        out = jnp.where(in_chunk[:, None], got, out)
+        got = hidden[batch, jnp.clip(last - c0, 0, chunk - 1)]
+        x_last = jnp.where(in_chunk[:, None], got.astype(jnp.float32), x_last)
     cache = cache.with_length(lengths)
-    return out, cache
+    logits = _unembed(cfg, params, x_last.astype(hidden.dtype))
+    return logits, cache
 
 
 def decode_step(

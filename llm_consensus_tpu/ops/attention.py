@@ -139,6 +139,7 @@ def chunk_decode_attention(
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     valid_len: jnp.ndarray,
+    window: int = 0,
 ) -> jnp.ndarray:
     """K-token chunk decode against the cache (speculative verification).
 
@@ -148,13 +149,18 @@ def chunk_decode_attention(
     < valid_len + i + 1 — ragged causal within the chunk, exactly the
     one-token :func:`decode_attention` rule extended to K queries (one
     forward verifies a whole draft, the speculative-decoding hot path).
+    ``window`` > 0 (Mistral): token i also ignores slots
+    <= valid_len + i - window (cache slot j holds position j).
     """
     scale = q.shape[-1] ** -0.5
     scores = _gqa_scores(q, k_cache) * scale  # [B, Hkv, G, K, S]
     kq = q.shape[1]
     s = k_cache.shape[1]
     limit = valid_len[:, None, None] + jnp.arange(kq)[None, :, None] + 1
-    mask = jnp.arange(s)[None, None, :] < limit  # [B, K, S]
+    slots = jnp.arange(s)[None, None, :]
+    mask = slots < limit  # [B, K, S]
+    if window > 0:
+        mask &= slots > limit - 1 - window
     scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)

@@ -337,3 +337,34 @@ def test_engine_mesh_batch_buckets_respect_data_axis(tiny):
     results = eng.generate_texts(["a", "bb", "ccc"])
     assert len(results) == 3
     assert all(r.num_tokens >= 1 for r in results)
+
+
+def test_engine_chunked_prefill_matches_oneshot(tiny):
+    """prefill_chunk engines produce identical texts to one-shot."""
+    cfg, params = tiny
+    base = EngineConfig(
+        max_new_tokens=5, seq_buckets=(32,), batch_buckets=(1, 2)
+    )
+    from dataclasses import replace
+
+    oneshot = InferenceEngine(cfg, params, engine_config=base)
+    chunked = InferenceEngine(
+        cfg, params, engine_config=replace(base, prefill_chunk=8)
+    )
+    prompts = ["the quick brown fox jumps over", "a longer test prompt here"]
+    want = [r.text for r in oneshot.generate_texts(prompts)]
+    got = [r.text for r in chunked.generate_texts(prompts)]
+    assert got == want
+
+
+def test_engine_rejects_prefill_chunk_with_kv_quant(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        InferenceEngine(
+            cfg,
+            params,
+            engine_config=EngineConfig(
+                seq_buckets=(16,), batch_buckets=(1,),
+                prefill_chunk=8, kv_quant=True,
+            ),
+        )

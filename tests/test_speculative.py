@@ -319,3 +319,44 @@ def test_engine_speculative_chunks_past_batch_bucket():
     results = eng.generate_texts_speculative([f"q{i}" for i in range(5)])
     assert len(results) == 5
     assert all(r.num_tokens >= 1 for r in results)
+
+
+def test_decode_chunk_sliding_window_matches_sequential():
+    """Windowed (Mistral-style) chunk decode == sequential decode_steps."""
+    cfg_w = CFG.with_(sliding_window=4)
+    params = _params(0)
+    tokens, lengths = _prompt_batch()
+    chunk_tokens = jnp.asarray([[21, 22, 23], [24, 25, 26]], jnp.int32)
+
+    cache = KVCache.create(cfg_w, 2, 32, dtype=jnp.float32)
+    _, cache = prefill(cfg_w, params, tokens, lengths, cache)
+    seq_logits = []
+    c = cache
+    for i in range(3):
+        lg, c = decode_step(cfg_w, params, chunk_tokens[:, i : i + 1], c)
+        seq_logits.append(lg)
+    want = jnp.stack(seq_logits, axis=1)
+
+    cache2 = KVCache.create(cfg_w, 2, 32, dtype=jnp.float32)
+    _, cache2 = prefill(cfg_w, params, tokens, lengths, cache2)
+    got, _ = decode_chunk(cfg_w, params, chunk_tokens, cache2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_speculative_equals_greedy_sliding_window():
+    """Speculative decode stays exact on a windowed config."""
+    cfg_w = CFG.with_(sliding_window=4)
+    params_t = init_params(cfg_w, jax.random.PRNGKey(0), dtype=jnp.float32)
+    params_d = init_params(cfg_w, jax.random.PRNGKey(9), dtype=jnp.float32)
+    tokens, lengths = _prompt_batch()
+    want = generate(
+        cfg_w, params_t, tokens, lengths, jax.random.PRNGKey(0),
+        jnp.zeros((2,)), max_new_tokens=8, eos_id=-1,
+    ).tokens
+    out = speculative_generate(
+        cfg_w, params_t, cfg_w, params_d, tokens, lengths,
+        max_new_tokens=8, k_spec=3, eos_id=-1,
+    )
+    assert out.tokens.tolist() == want.tolist()
