@@ -81,16 +81,36 @@ class LocalBackend(Backend):
 
             _, max_new, top_k, top_p = key
             reqs = [requests[i] for i in idxs]
-            outs = eng.generate_texts(
-                [r.prompt for r in reqs],
-                temperatures=[r.params.temperature for r in reqs],
-                # One batch shares a PRNG key; per-row independence comes
-                # from the batched categorical. Mix the first seed in so
-                # distinct requests get distinct streams.
-                seed=reqs[0].params.seed,
-                max_new_tokens=max_new,
-                sampler=SamplerConfig(top_k=top_k, top_p=top_p),
-            )
+            # All-greedy groups ride speculative decoding when the
+            # engine carries a draft model — safe because greedy
+            # speculative output is exactly the greedy output (tested).
+            # The speculative program is single-device, bf16-KV,
+            # one-shot-prefill: engines configured otherwise keep the
+            # plain path (routing must never change the numerics class
+            # or drop the sharding/memory strategy the user configured).
+            if (
+                eng.draft is not None
+                and eng.mesh is None
+                and not eng.config.kv_quant
+                and eng.config.prefill_chunk == 0
+                and top_k == 0
+                and top_p == 1.0
+                and all(r.params.temperature == 0.0 for r in reqs)
+            ):
+                outs = eng.generate_texts_speculative(
+                    [r.prompt for r in reqs], max_new_tokens=max_new
+                )
+            else:
+                outs = eng.generate_texts(
+                    [r.prompt for r in reqs],
+                    temperatures=[r.params.temperature for r in reqs],
+                    # One batch shares a PRNG key; per-row independence
+                    # comes from the batched categorical. Mix the first
+                    # seed in so distinct requests get distinct streams.
+                    seed=reqs[0].params.seed,
+                    max_new_tokens=max_new,
+                    sampler=SamplerConfig(top_k=top_k, top_p=top_p),
+                )
             for i, out in zip(idxs, outs):
                 results[i] = GenerationResult(
                     text=out.text,

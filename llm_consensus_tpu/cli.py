@@ -81,6 +81,26 @@ def _build_backend(args):
             cfg.name,
         )
         params = init_params(cfg, jax.random.PRNGKey(0))
+    draft = None
+    if args.draft_checkpoint and not args.draft_model:
+        raise SystemExit(
+            "--draft-checkpoint requires --draft-model (which preset "
+            "should load those weights?)"
+        )
+    if args.draft_model:
+        dcfg = get_config(args.draft_model)
+        if args.draft_checkpoint:
+            from llm_consensus_tpu.checkpoint.io import load_params
+
+            dparams = load_params(args.draft_checkpoint)
+        else:
+            log.warning(
+                "No --draft-checkpoint: random draft weights for %s "
+                "(speculation stays exact but accepts ~nothing).",
+                dcfg.name,
+            )
+            dparams = init_params(dcfg, jax.random.PRNGKey(1))
+        draft = (dcfg, dparams)
     mesh = None
     if args.mesh:
         from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -100,6 +120,7 @@ def _build_backend(args):
             max_new_tokens=args.max_new_tokens, quant=args.quant
         ),
         mesh=mesh,
+        draft=draft,
     )
     return LocalBackend(engine)
 
@@ -125,6 +146,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="weight-only quantization for the local engine",
     )
     p.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
+    p.add_argument(
+        "--draft-model",
+        default=None,
+        help="model preset for a speculative-decoding draft (greedy "
+        "requests then ride draft-and-verify; output is unchanged)",
+    )
+    p.add_argument(
+        "--draft-checkpoint",
+        default=None,
+        help="orbax checkpoint dir for the draft model's weights",
+    )
     p.add_argument(
         "--mesh",
         default=None,

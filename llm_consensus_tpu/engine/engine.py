@@ -274,8 +274,9 @@ class InferenceEngine:
 
         Requires ``draft=(cfg, params)`` at engine construction. Output
         text is IDENTICAL to greedy ``generate_texts`` (speculation only
-        changes speed — tested); greedy-only, single-device, no
-        logprobs (reported as 0.0).
+        changes speed — tested); greedy-only, single-device, bf16 KV,
+        one-shot prefill. Logprobs follow the same convention as the
+        plain path (target log_softmax of emitted tokens).
         """
         if self.draft is None:
             raise ValueError("engine was built without a draft model")
@@ -316,6 +317,7 @@ class InferenceEngine:
         )
         toks = np.asarray(out.tokens)
         nums = np.asarray(out.num_tokens)
+        lps = np.asarray(out.logprob_sum)
         results = []
         for i in range(n_real):
             n = int(nums[i])
@@ -324,7 +326,7 @@ class InferenceEngine:
                 EngineResult(
                     text=self.tokenizer.decode(ids),
                     num_tokens=n,
-                    logprob=0.0,
+                    logprob=float(lps[i]),
                     token_ids=ids,
                 )
             )

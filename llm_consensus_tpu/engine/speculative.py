@@ -86,6 +86,11 @@ def leviathan_accept(
 class SpecOutput:
     tokens: jnp.ndarray  # [B, max_new_tokens] int32, pad-filled after EOS
     num_tokens: jnp.ndarray  # [B] int32 generated tokens incl. EOS
+    # [B] float32 sum of emitted-token logprobs under the target's
+    # distribution — same convention as engine.generate (temperature-
+    # scaled log_softmax; scale 1 for greedy rows), so logit_pool
+    # consumers see equivalent weights on either path.
+    logprob_sum: jnp.ndarray
     rounds: jnp.ndarray  # [] int32 — speculation rounds taken
     drafted: jnp.ndarray  # [] int32 — draft tokens proposed in total
     accepted: jnp.ndarray  # [] int32 — draft tokens accepted in total
@@ -169,22 +174,43 @@ def speculative_generate(
             greedy_row[:, 0], greedy, drawn.astype(jnp.int32)
         )
 
+    def _lp_of(logits_nd, toks):
+        """Emitted-token logprobs, engine.sampler convention: scale 1
+        for greedy (and the no-temperature mode), t elsewhere."""
+        if sampled:
+            scale = jnp.where(
+                temperature > 0, temperature, 1.0
+            ).reshape((b,) + (1,) * (logits_nd.ndim - 1))
+            logits_nd = logits_nd / scale
+        lp = jax.nn.log_softmax(logits_nd, axis=-1)
+        return jnp.take_along_axis(lp, toks[..., None], axis=-1)[..., 0]
+
     # First token comes from the target's prefill logits directly.
     k0 = jax.random.fold_in(key, 0) if sampled else None
     tok0 = _pick(logits_t, k0)  # [B]
     out0 = jnp.full((b, max_new_tokens), pad_id, jnp.int32)
     out0 = out0.at[:, 0].set(tok0)
     n0 = jnp.ones((b,), jnp.int32)
+    lp0 = _lp_of(logits_t, tok0)  # [B]
     done0 = (tok0 == eos_id) | (max_new_tokens <= 1)
 
     def cond(state):
-        _, _, _, _, n_out, done, rounds, _, _ = state
+        _, _, _, _, n_out, _, done, rounds, _, _ = state
         return jnp.any(~done) & (rounds < max_new_tokens)
 
     def body(state):
-        tok, cache_t, cache_d, out, n_out, done, rounds, drafted, accepted = (
-            state
-        )
+        (
+            tok,
+            cache_t,
+            cache_d,
+            out,
+            n_out,
+            lp_sum,
+            done,
+            rounds,
+            drafted,
+            accepted,
+        ) = state
         done_before = done
         len_t0 = cache_t.length
         len_d0 = cache_d.length
@@ -304,6 +330,12 @@ def speculative_generate(
         cache_t = cache_t.with_length(len_t0 + consumed)
         cache_d = cache_d.with_length(len_d0 + consumed)
 
+        # Emitted-token logprobs under the target (engine convention).
+        lp_emit = _lp_of(logits, emit)  # [B, K+1]
+        lp_sum = lp_sum + jnp.sum(
+            jnp.where(j < emit_cnt[:, None], lp_emit, 0.0), axis=1
+        )
+
         n_out = n_out + emit_cnt
         done = done | any_eos | (n_out >= max_new_tokens)
         drafted = drafted + k_spec * jnp.sum((~done_before).astype(jnp.int32))
@@ -314,6 +346,7 @@ def speculative_generate(
             cache_d,
             new_out,
             n_out,
+            lp_sum,
             done,
             rounds + 1,
             drafted,
@@ -327,16 +360,18 @@ def speculative_generate(
         cache_d,
         out0,
         n0,
+        lp0,
         done0,
         zero,
         zero,
         zero,
     )
     state = jax.lax.while_loop(cond, body, state)
-    _, _, _, out, n_out, _, rounds, drafted, accepted = state
+    _, _, _, out, n_out, lp_sum, _, rounds, drafted, accepted = state
     return SpecOutput(
         tokens=out,
         num_tokens=n_out,
+        logprob_sum=lp_sum,
         rounds=rounds,
         drafted=drafted,
         accepted=accepted,

@@ -93,3 +93,103 @@ def test_consensus_over_local_backend(backend):
     assert isinstance(result.answer, str) and result.answer != ""
     assert 1 <= result.rounds <= 2
     assert result.author in {p.name for p in coord.panel}
+
+
+def test_greedy_requests_ride_speculative_with_draft():
+    """A draft-equipped engine serves greedy generate_batch through the
+    speculative path with unchanged output."""
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ec = EngineConfig(max_new_tokens=6, seq_buckets=(16,), batch_buckets=(1, 2))
+    plain = LocalBackend(InferenceEngine(cfg, params, engine_config=ec))
+    drafted = LocalBackend(
+        InferenceEngine(
+            cfg, params, engine_config=ec,
+            draft=(cfg, init_params(cfg, jax.random.PRNGKey(7))),
+        )
+    )
+    reqs = [
+        GenerationRequest(prompt="What is 2+2?"),
+        GenerationRequest(prompt="Name a color."),
+    ]
+    want = asyncio.run(plain.generate_batch(reqs))
+    got = asyncio.run(drafted.generate_batch(reqs))
+    assert [r.text for r in got] == [r.text for r in want]
+
+
+def test_speculative_routing_actually_fires(monkeypatch):
+    """The greedy batch takes the speculative path (spy), and a
+    mesh/kv_quant engine does NOT."""
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ec = EngineConfig(max_new_tokens=4, seq_buckets=(16,), batch_buckets=(2,))
+    eng = InferenceEngine(
+        cfg, params, engine_config=ec,
+        draft=(cfg, init_params(cfg, jax.random.PRNGKey(7))),
+    )
+    calls = {"spec": 0, "plain": 0}
+    orig_spec = eng.generate_texts_speculative
+    orig_plain = eng.generate_texts
+    monkeypatch.setattr(
+        eng, "generate_texts_speculative",
+        lambda *a, **k: calls.__setitem__("spec", calls["spec"] + 1)
+        or orig_spec(*a, **k),
+    )
+    monkeypatch.setattr(
+        eng, "generate_texts",
+        lambda *a, **k: calls.__setitem__("plain", calls["plain"] + 1)
+        or orig_plain(*a, **k),
+    )
+    backend = LocalBackend(eng)
+    asyncio.run(
+        backend.generate_batch([GenerationRequest(prompt="greedy one")])
+    )
+    assert calls == {"spec": 1, "plain": 0}
+    # A sampled request keeps the plain path.
+    from llm_consensus_tpu.backends.base import SamplingParams
+
+    asyncio.run(
+        backend.generate_batch(
+            [GenerationRequest(prompt="hot", params=SamplingParams(temperature=0.9))]
+        )
+    )
+    assert calls == {"spec": 1, "plain": 1}
+
+    # kv_quant engine with a draft: greedy requests must NOT reroute
+    # (int8-KV greedy is a different numerics class).
+    eng_q = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=4, seq_buckets=(16,), batch_buckets=(2,),
+            kv_quant=True,
+        ),
+        draft=(cfg, init_params(cfg, jax.random.PRNGKey(7))),
+    )
+    spec_called = []
+    monkeypatch.setattr(
+        eng_q, "generate_texts_speculative",
+        lambda *a, **k: spec_called.append(1),
+    )
+    asyncio.run(
+        LocalBackend(eng_q).generate_batch(
+            [GenerationRequest(prompt="greedy q")]
+        )
+    )
+    assert not spec_called
+
+
+def test_speculative_logprobs_match_plain_greedy():
+    """Draft-path logprobs follow the plain greedy convention (close up
+    to fp reassociation between the chunk and one-token programs)."""
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ec = EngineConfig(max_new_tokens=5, seq_buckets=(16,), batch_buckets=(2,))
+    plain = InferenceEngine(cfg, params, engine_config=ec)
+    drafted = InferenceEngine(
+        cfg, params, engine_config=ec, draft=(cfg, params)
+    )
+    prompts = ["alpha beta", "gamma"]
+    want = plain.generate_texts(prompts)
+    got = drafted.generate_texts_speculative(prompts)
+    for w, g in zip(want, got):
+        assert abs(w.logprob - g.logprob) < 1e-3
