@@ -10,6 +10,11 @@ self-consistency batch is ONE compiled device program: prefill + a
 from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
 from llm_consensus_tpu.engine.generate import GenerateOutput, generate
 from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
+from llm_consensus_tpu.engine.speculative import (
+    SpecOutput,
+    leviathan_accept,
+    speculative_generate,
+)
 from llm_consensus_tpu.engine.tokenizer import (
     ByteTokenizer,
     Tokenizer,
@@ -22,8 +27,11 @@ __all__ = [
     "GenerateOutput",
     "InferenceEngine",
     "SamplerConfig",
+    "SpecOutput",
     "Tokenizer",
     "generate",
+    "leviathan_accept",
     "load_tokenizer",
     "sample_token",
+    "speculative_generate",
 ]

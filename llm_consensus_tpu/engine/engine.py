@@ -79,9 +79,14 @@ class InferenceEngine:
         engine_config: EngineConfig | None = None,
         mesh=None,
         draft: tuple[ModelConfig, dict] | None = None,
+        tracer=None,
     ):
         self.cfg = cfg
         self.params = params
+        # Optional utils.tracing.Tracer: generate calls record
+        # "engine.generate" / "engine.generate_speculative" spans
+        # (batch shape + real request count).
+        self.tracer = tracer
         self.tokenizer = tokenizer or ByteTokenizer()
         if self.tokenizer.vocab_size > cfg.vocab_size:
             raise ValueError(
@@ -208,6 +213,35 @@ class InferenceEngine:
                 )
             return out
         tokens, lengths, n_real = self._prepare(prompts)
+        with self._span(
+            "engine.generate",
+            batch=tokens.shape[0],
+            seq=tokens.shape[1],
+            n_real=n_real,
+        ):
+            return self._generate_prepared(
+                prompts, tokens, lengths, n_real, temperatures, seed,
+                max_new_tokens, sampler,
+            )
+
+    def _span(self, name: str, **meta):
+        if self.tracer is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **meta)
+
+    def _generate_prepared(
+        self,
+        prompts,
+        tokens,
+        lengths,
+        n_real,
+        temperatures,
+        seed,
+        max_new_tokens,
+        sampler,
+    ) -> list[EngineResult]:
         b = tokens.shape[0]
         temps = np.zeros((b,), np.float32)
         if temperatures is not None:
@@ -303,18 +337,25 @@ class InferenceEngine:
         # so outputs stay identical to the greedy path.
         mnt = max_new_tokens or self.config.max_new_tokens
         mnt = max(1, min(mnt, self.cfg.max_seq_len - tokens.shape[1]))
-        out = speculative_generate(
-            self.cfg,
-            self.params,
-            draft_cfg,
-            draft_params,
-            jnp.asarray(tokens),
-            jnp.asarray(lengths),
-            max_new_tokens=mnt,
+        with self._span(
+            "engine.generate_speculative",
+            batch=tokens.shape[0],
+            seq=tokens.shape[1],
+            n_real=n_real,
             k_spec=k_spec,
-            eos_id=self.tokenizer.eos_id,
-            pad_id=self.tokenizer.pad_id,
-        )
+        ):
+            out = speculative_generate(
+                self.cfg,
+                self.params,
+                draft_cfg,
+                draft_params,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                max_new_tokens=mnt,
+                k_spec=k_spec,
+                eos_id=self.tokenizer.eos_id,
+                pad_id=self.tokenizer.pad_id,
+            )
         toks = np.asarray(out.tokens)
         nums = np.asarray(out.num_tokens)
         lps = np.asarray(out.logprob_sum)

@@ -4,6 +4,8 @@ from llm_consensus_tpu.models.transformer import (
     init_params,
     forward,
     prefill,
+    prefill_chunked,
+    decode_chunk,
     decode_step,
     param_count,
 )
@@ -16,6 +18,8 @@ __all__ = [
     "init_params",
     "forward",
     "prefill",
+    "prefill_chunked",
+    "decode_chunk",
     "decode_step",
     "param_count",
 ]

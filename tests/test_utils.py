@@ -38,3 +38,57 @@ def test_tracer_spans_and_summary(tmp_path):
     assert len(data) == 3
     assert {d["name"] for d in data} == {"evaluate", "decode"}
     assert any(d.get("meta") == {"round": 1} for d in data)
+
+
+def test_engine_records_generate_spans():
+    import jax
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.utils.tracing import Tracer
+
+    cfg = get_config("test-tiny")
+    tracer = Tracer()
+    eng = InferenceEngine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32),
+        engine_config=EngineConfig(
+            max_new_tokens=3, seq_buckets=(16,), batch_buckets=(1, 2)
+        ),
+        tracer=tracer,
+    )
+    eng.generate_texts(["one", "two"])
+    spans = [r for r in tracer.records if r.name == "engine.generate"]
+    assert len(spans) == 1
+    assert spans[0].meta["n_real"] == 2
+    assert spans[0].duration > 0
+
+
+def test_engine_records_speculative_spans():
+    import jax
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.utils.tracing import Tracer
+
+    cfg = get_config("test-tiny")
+    tracer = Tracer()
+    eng = InferenceEngine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32),
+        engine_config=EngineConfig(
+            max_new_tokens=3, seq_buckets=(16,), batch_buckets=(1, 2)
+        ),
+        draft=(cfg, init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)),
+        tracer=tracer,
+    )
+    eng.generate_texts_speculative(["one"])
+    spans = [
+        r for r in tracer.records if r.name == "engine.generate_speculative"
+    ]
+    assert len(spans) == 1
+    assert spans[0].meta["k_spec"] == 4
