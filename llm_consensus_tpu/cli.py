@@ -180,6 +180,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--question", default=None, help="answer one question and exit"
     )
     p.add_argument(
+        "--debate",
+        type=int,
+        default=0,
+        metavar="N",
+        help="answer --question via N-candidate multi-round debate "
+        "(consensus/debate.py) instead of the panel protocol "
+        "(needs --backend local)",
+    )
+    p.add_argument(
         "--eval-gsm8k",
         default=None,
         metavar="JSONL|bundled|synthetic",
@@ -218,6 +227,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.eval_gsm8k is not None:
         return _run_eval(args)
+    if args.debate:
+        return _run_debate(args)
 
     panel = load_panel(args.panel) if args.panel else default_panel()
     backend = _build_backend(args)
@@ -238,6 +249,40 @@ def main(argv: list[str] | None = None) -> int:
         print(result.answer)
         return 0
     asyncio.run(repl(coord))
+    return 0
+
+
+def _run_debate(args) -> int:
+    from llm_consensus_tpu.consensus.debate import DebateConfig, run_debate
+
+    if args.backend == "fake":
+        print("--debate needs --backend local", file=sys.stderr)
+        return 2
+    if not args.question:
+        print("--debate needs --question", file=sys.stderr)
+        return 2
+    if args.debate < 1:
+        print(f"--debate needs N >= 1, got {args.debate}", file=sys.stderr)
+        return 2
+    backend = _build_backend(args)
+    result = run_debate(
+        backend.engine,
+        args.question,
+        DebateConfig(
+            n_candidates=args.debate,
+            max_rounds=args.max_rounds,
+            temperature=args.temperature,
+            max_new_tokens=args.max_new_tokens,
+            seed=args.seed or 0,
+        ),
+    )
+    log.info(
+        "Debate: %d rounds, %d candidate-tokens, winner tally %s",
+        result.n_rounds,
+        result.total_tokens,
+        result.vote.tally,
+    )
+    print(result.answer)
     return 0
 
 

@@ -138,3 +138,38 @@ def test_cli_mesh_flag_shards_engine(capsys):
     )
     assert rc == 0
     assert capsys.readouterr().out.strip()
+
+
+def test_debate_mode_one_shot(capsys):
+    from llm_consensus_tpu.cli import main
+
+    rc = main(
+        [
+            "--backend", "local",
+            "--model", "test-tiny",
+            "--question", "What is 2+2?",
+            "--debate", "4",
+            "--max-rounds", "2",
+            "--max-new-tokens", "4",
+            "--seed", "0",
+        ]
+    )
+    assert rc == 0
+    assert capsys.readouterr().out.strip()
+
+
+def test_debate_requires_local_and_question(capsys):
+    from llm_consensus_tpu.cli import main
+
+    assert main(["--debate", "4", "--question", "q"]) == 2  # fake backend
+    assert main(["--backend", "local", "--model", "test-tiny", "--debate", "4"]) == 2
+
+
+def test_debate_rejects_bad_n(capsys):
+    from llm_consensus_tpu.cli import main
+
+    rc = main([
+        "--backend", "local", "--model", "test-tiny",
+        "--question", "q", "--debate", "-1",
+    ])
+    assert rc == 2
