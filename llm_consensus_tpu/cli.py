@@ -182,7 +182,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--debate",
         type=int,
-        default=0,
+        default=None,
         metavar="N",
         help="answer --question via N-candidate multi-round debate "
         "(consensus/debate.py) instead of the panel protocol "
@@ -227,7 +227,7 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.eval_gsm8k is not None:
         return _run_eval(args)
-    if args.debate:
+    if args.debate is not None:
         return _run_debate(args)
 
     panel = load_panel(args.panel) if args.panel else default_panel()

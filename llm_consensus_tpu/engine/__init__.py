@@ -8,7 +8,12 @@ self-consistency batch is ONE compiled device program: prefill + a
 """
 
 from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
-from llm_consensus_tpu.engine.generate import GenerateOutput, generate
+from llm_consensus_tpu.engine.generate import (
+    GenerateOutput,
+    generate,
+    generate_from_prefix,
+)
+from llm_consensus_tpu.engine.prefix_cache import PrefixCache
 from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
 from llm_consensus_tpu.engine.speculative import (
     SpecOutput,
@@ -26,10 +31,12 @@ __all__ = [
     "EngineConfig",
     "GenerateOutput",
     "InferenceEngine",
+    "PrefixCache",
     "SamplerConfig",
     "SpecOutput",
     "Tokenizer",
     "generate",
+    "generate_from_prefix",
     "leviathan_accept",
     "load_tokenizer",
     "sample_token",

@@ -24,6 +24,18 @@ from llm_consensus_tpu.models.configs import ModelConfig
 
 log = logging.getLogger(__name__)
 
+# Jitted prefix-prefill entry points (engine.prefix_cache misses). Module
+# level so repeat misses at the same shapes hit the jit cache.
+from llm_consensus_tpu.models.transformer import (  # noqa: E402
+    prefill as _prefill_raw,
+    prefill_chunked as _prefill_chunked_raw,
+)
+
+_jit_prefill = jax.jit(_prefill_raw, static_argnames=("cfg", "mesh"))
+_jit_prefill_chunked = jax.jit(
+    _prefill_chunked_raw, static_argnames=("cfg", "chunk")
+)
+
 
 def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
     for b in buckets:
@@ -51,6 +63,11 @@ class EngineConfig:
     # (models/transformer.prefill_chunked) — bounded activation memory
     # for long contexts; bf16 cache only.
     prefill_chunk: int = 0
+    # Host-side prefix cache (engine/prefix_cache.py): shared prompt
+    # prefixes (few-shot headers, debate transcripts) are prefilled once
+    # and their K/V reused across calls. Entry/byte budgets bound HBM.
+    prefix_cache_entries: int = 8
+    prefix_cache_bytes: int = 1 << 30
 
 
 @dataclass
@@ -111,6 +128,12 @@ class InferenceEngine:
         # Optional draft model for generate_texts_speculative: a
         # (config, params) pair sharing this model's tokenizer/vocab.
         self.draft = draft
+        from llm_consensus_tpu.engine.prefix_cache import PrefixCache
+
+        self.prefix_cache = PrefixCache(
+            max_entries=self.config.prefix_cache_entries,
+            max_bytes=self.config.prefix_cache_bytes,
+        )
         self.mesh = mesh
         self._data_sharding = None
         if mesh is not None:
@@ -134,17 +157,21 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _prepare(
-        self, prompts: list[str]
+        self, prompts: list[str], add_bos: bool = True, max_cap: int | None = None
     ) -> tuple[np.ndarray, np.ndarray, int]:
         tok = self.tokenizer
         # Left-truncate over-long prompts (keep the question tail); the cap
         # is the model context, not just the largest bucket.
         max_prompt = min(self.config.seq_buckets[-1], self.cfg.max_seq_len - 1)
-        native = self._native_encode(prompts, max_prompt)
+        if max_cap is not None:
+            max_prompt = min(max_prompt, max_cap)
+        native = self._native_encode(prompts, max_prompt) if add_bos else None
         if native is not None:
             enc_tokens, enc_lengths = native
         else:
-            encoded = [tok.encode(p)[-max_prompt:] for p in prompts]
+            encoded = [
+                tok.encode(p, add_bos=add_bos)[-max_prompt:] for p in prompts
+            ]
             enc_lengths = np.array([len(ids) for ids in encoded], np.int32)
             enc_tokens = np.full((len(prompts), max_prompt), tok.pad_id, np.int32)
             for i, ids in enumerate(encoded):
@@ -184,12 +211,28 @@ class InferenceEngine:
         seed: int = 0,
         max_new_tokens: int | None = None,
         sampler: SamplerConfig | None = None,
+        prefix: str | None = None,
+        stop: list[str] | None = None,
     ) -> list[EngineResult]:
         """Generate one completion per prompt.
 
         One device program per chunk of ``batch_buckets[-1]`` prompts;
         most calls fit a single chunk. ``sampler`` overrides the engine's
         default top-k/top-p config for this call.
+
+        ``prefix``: a shared prompt prefix — the effective prompt for row
+        i is ``prefix + prompts[i]``. The prefix's K/V is prefilled once
+        and cached on device (``self.prefix_cache``), so later calls with
+        the same prefix skip its prefill entirely. Falls back to plain
+        concatenated generation on sharded engines / quant KV caches
+        (no chunk-continuation path there). Prefix and suffix are
+        tokenized separately (the universal prefix-caching caveat: for
+        merge-based tokenizers, split at a whitespace/newline boundary).
+
+        ``stop``: stop sequences. Generation text is trimmed at the
+        earliest occurrence of any stop string (the stop itself is
+        removed); stops that tokenize to a single id also terminate the
+        device decode loop early for their row, like EOS.
         """
         if not prompts:
             return []
@@ -209,9 +252,21 @@ class InferenceEngine:
                         seed=seed + i,
                         max_new_tokens=max_new_tokens,
                         sampler=sampler,
+                        prefix=prefix,
+                        stop=stop,
                     )
                 )
             return out
+        if prefix:
+            if self.mesh is None and not self.config.kv_quant:
+                return self._generate_with_prefix(
+                    prompts, prefix, temperatures, seed, max_new_tokens,
+                    sampler, stop,
+                )
+            # No chunk-continuation path for sharded/quant caches — same
+            # output via plain generation on the concatenated prompts.
+            log.debug("prefix cache bypassed (mesh/kv_quant engine)")
+            prompts = [prefix + p for p in prompts]
         tokens, lengths, n_real = self._prepare(prompts)
         with self._span(
             "engine.generate",
@@ -221,8 +276,182 @@ class InferenceEngine:
         ):
             return self._generate_prepared(
                 prompts, tokens, lengths, n_real, temperatures, seed,
-                max_new_tokens, sampler,
+                max_new_tokens, sampler, stop=stop,
             )
+
+    # -- prefix-cached generation --------------------------------------
+
+    def _stop_ids(self, stop: list[str] | None) -> tuple[int, ...]:
+        """Stops that tokenize to exactly one id terminate on device."""
+        if not stop:
+            return ()
+        ids = []
+        for s in stop:
+            enc = self.tokenizer.encode(s, add_bos=False)
+            if len(enc) == 1:
+                ids.append(enc[0])
+        return tuple(dict.fromkeys(ids))
+
+    @staticmethod
+    def _trim_stops(results: list[EngineResult], stop: list[str] | None):
+        """Cut each text at the earliest stop occurrence (stop removed).
+
+        ``num_tokens``/``logprob`` keep the device-loop accounting (they
+        include any overshoot past a multi-token stop) — throughput
+        numbers stay honest about what was actually decoded.
+        """
+        if not stop:
+            return results
+        for r in results:
+            cut = min(
+                (i for s in stop if (i := r.text.find(s)) >= 0),
+                default=-1,
+            )
+            if cut >= 0:
+                r.text = r.text[:cut]
+        return results
+
+    def _prefix_kv(self, prefix: str):
+        """(true_len, k, v) for the prefilled prefix (cached).
+
+        The stored buffers are right-padded to the pow2 bucket of the
+        true length (bounds distinct compiled programs at log2(ctx) and
+        makes repeat cache hits zero-copy); pad-slot garbage is never
+        attended — ``generate_from_prefix`` masks by the traced true
+        length.
+        """
+        from llm_consensus_tpu.models.cache import KVCache
+
+        max_prefix = self.cfg.max_seq_len - 2  # room for >=1 suffix token
+        ids = self.tokenizer.encode(prefix)[-max_prefix:]
+        key = tuple(ids)
+        p = len(ids)
+        hit = self.prefix_cache.get(key)
+        if hit is not None:
+            return key, p, hit
+        pb = min(1 << max(p - 1, 0).bit_length(), max_prefix)
+        cache = KVCache.create(self.cfg, 1, pb)
+        tokens = jnp.asarray(
+            [ids + [self.tokenizer.pad_id] * (pb - p)], jnp.int32
+        )
+        lengths = jnp.asarray([p], jnp.int32)
+        if self.config.prefill_chunk and pb > self.config.prefill_chunk:
+            _, cache = _jit_prefill_chunked(
+                self.cfg, self.params, tokens, lengths, cache,
+                chunk=self.config.prefill_chunk,
+            )
+        else:
+            _, cache = _jit_prefill(
+                self.cfg, self.params, tokens, lengths, cache
+            )
+        entry = (cache.k, cache.v)
+        self.prefix_cache.put(key, *entry)
+        return key, p, entry
+
+    def _generate_with_prefix(
+        self, prompts, prefix, temperatures, seed, max_new_tokens, sampler,
+        stop,
+    ) -> list[EngineResult]:
+        from llm_consensus_tpu.engine.generate import generate_from_prefix
+
+        # Suffixes that cannot sit whole after the prefix (or that exceed
+        # the configured chunked-prefill bound) take the plain
+        # concatenated path instead: it left-truncates keeping the tail
+        # of prefix+question and honors prefill_chunk — silently
+        # crushing the question to fit a long header would be worse than
+        # losing the cache reuse.
+        suffix_lens = [
+            len(self.tokenizer.encode(q, add_bos=False)) for q in prompts
+        ]
+        p_est = min(
+            len(self.tokenizer.encode(prefix)), self.cfg.max_seq_len - 2
+        )
+
+        def _fallback():
+            log.debug("prefix cache bypassed (suffix does not fit)")
+            return self.generate_texts(
+                [prefix + q for q in prompts],
+                temperatures=temperatures,
+                seed=seed,
+                max_new_tokens=max_new_tokens,
+                sampler=sampler,
+                stop=stop,
+            )
+
+        if p_est + max(suffix_lens) + 1 > self.cfg.max_seq_len:
+            return _fallback()
+        key, p, (pk, pv) = self._prefix_kv(prefix)
+        tokens, lengths, n_real = self._prepare(
+            prompts, add_bos=False, max_cap=self.cfg.max_seq_len - p - 1
+        )
+        if int(lengths[:n_real].min()) < 1:
+            raise ValueError("empty suffix under a prefix; fold it into one")
+        b, s = tokens.shape
+        if self.config.prefill_chunk and s > self.config.prefill_chunk:
+            return _fallback()  # suffix chunk would unbound prefill memory
+        # The stored prefix is padded to the pow2 bucket of its true
+        # length (zero-copy on hit); the true length rides as a traced
+        # scalar. Token budgets below clamp on the BUCKETED widths —
+        # near the context limit this is a few tokens more conservative
+        # than the true headroom, the same bucket conservatism as the
+        # plain path.
+        pb = pk.shape[2]
+        if pb + s > self.cfg.max_seq_len:
+            pb = self.cfg.max_seq_len - s
+            if pb < p:
+                return _fallback()  # bucket rounding left no room
+            pk, pv = pk[:, :, :pb], pv[:, :, :pb]
+        temps = np.zeros((b,), np.float32)
+        if temperatures is not None:
+            temps[:n_real] = np.asarray(temperatures, np.float32)
+        mnt = max_new_tokens or self.config.max_new_tokens
+        mnt = max(1, min(mnt, self.cfg.max_seq_len - pb - s))
+        # Identical suffixes (self-consistency fan-out under a cached
+        # header): chunk the suffix once at B=1 and broadcast.
+        shared = n_real == b and len(set(prompts)) == 1 and b > 1
+        with self._span(
+            "engine.generate_prefix",
+            batch=b,
+            prefix=p,
+            seq=s,
+            n_real=n_real,
+        ):
+            out = generate_from_prefix(
+                self.cfg,
+                self.params,
+                pk,
+                pv,
+                jnp.asarray(p, jnp.int32),
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jax.random.PRNGKey(seed),
+                jnp.asarray(temps),
+                max_new_tokens=mnt,
+                sampler=sampler if sampler is not None else self.config.sampler,
+                eos_id=self.tokenizer.eos_id,
+                pad_id=self.tokenizer.pad_id,
+                stop_ids=self._stop_ids(stop),
+                shared_suffix=shared,
+            )
+        return self._trim_stops(self._collect(out, n_real), stop)
+
+    def _collect(self, out: GenerateOutput, n_real: int) -> list[EngineResult]:
+        toks = np.asarray(out.tokens)
+        nums = np.asarray(out.num_tokens)
+        lps = np.asarray(out.logprob_sum)
+        results = []
+        for i in range(n_real):
+            n = int(nums[i])
+            ids = [int(t) for t in toks[i, :n] if t != self.tokenizer.eos_id]
+            results.append(
+                EngineResult(
+                    text=self.tokenizer.decode(ids),
+                    num_tokens=n,
+                    logprob=float(lps[i]),
+                    token_ids=ids,
+                )
+            )
+        return results
 
     def _span(self, name: str, **meta):
         if self.tracer is None:
@@ -241,6 +470,7 @@ class InferenceEngine:
         seed,
         max_new_tokens,
         sampler,
+        stop=None,
     ) -> list[EngineResult]:
         b = tokens.shape[0]
         temps = np.zeros((b,), np.float32)
@@ -279,24 +509,9 @@ class InferenceEngine:
             # model opts in and the mesh has a seq axis.
             mesh=self.mesh if self.cfg.use_ring else None,
             prefill_chunk=self.config.prefill_chunk,
+            stop_ids=self._stop_ids(stop),
         )
-        toks = np.asarray(out.tokens)
-        nums = np.asarray(out.num_tokens)
-        lps = np.asarray(out.logprob_sum)
-
-        results = []
-        for i in range(n_real):
-            n = int(nums[i])
-            ids = [int(t) for t in toks[i, :n] if t != self.tokenizer.eos_id]
-            results.append(
-                EngineResult(
-                    text=self.tokenizer.decode(ids),
-                    num_tokens=n,
-                    logprob=float(lps[i]),
-                    token_ids=ids,
-                )
-            )
-        return results
+        return self._trim_stops(self._collect(out, n_real), stop)
 
     def generate_texts_speculative(
         self,
@@ -356,19 +571,4 @@ class InferenceEngine:
                 eos_id=self.tokenizer.eos_id,
                 pad_id=self.tokenizer.pad_id,
             )
-        toks = np.asarray(out.tokens)
-        nums = np.asarray(out.num_tokens)
-        lps = np.asarray(out.logprob_sum)
-        results = []
-        for i in range(n_real):
-            n = int(nums[i])
-            ids = [int(t) for t in toks[i, :n] if t != self.tokenizer.eos_id]
-            results.append(
-                EngineResult(
-                    text=self.tokenizer.decode(ids),
-                    num_tokens=n,
-                    logprob=float(lps[i]),
-                    token_ids=ids,
-                )
-            )
-        return results
+        return self._collect(out, n_real)

@@ -74,6 +74,7 @@ class GenerateOutput:
         "kv_quant",
         "mesh",  # hashable; trace-time constant for the ring routing
         "prefill_chunk",
+        "stop_ids",
     ),
 )
 def generate(
@@ -93,6 +94,7 @@ def generate(
     kv_quant: bool = False,
     mesh=None,
     prefill_chunk: int = 0,
+    stop_ids: tuple[int, ...] = (),
 ) -> GenerateOutput:
     """Generate up to ``max_new_tokens`` for a batch of right-padded prompts.
 
@@ -141,25 +143,73 @@ def generate(
         cache = make_cache(cfg, b, cache_len)
         logits, cache = _prefill(tokens, lengths, cache)
 
+    return _decode_loop(
+        cfg,
+        params,
+        logits,
+        cache,
+        key,
+        temperature,
+        sampler=sampler,
+        eos_id=eos_id,
+        pad_id=pad_id,
+        max_new_tokens=max_new_tokens,
+        uniform_write=shared_prefill,
+        stop_ids=stop_ids,
+    )
+
+
+def _decode_loop(
+    cfg: ModelConfig,
+    params: dict,
+    logits: jnp.ndarray,
+    cache,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    *,
+    sampler: SamplerConfig,
+    eos_id: int,
+    pad_id: int,
+    max_new_tokens: int,
+    uniform_write: bool,
+    stop_ids: tuple[int, ...] = (),
+) -> GenerateOutput:
+    """The shared lax.scan decode loop, from first-token logits onward.
+
+    ``stop_ids`` (static): extra single-token terminators — a row that
+    samples any of them finishes exactly as if it sampled EOS (the stop
+    token is still emitted/counted, like EOS). Used by the engine for
+    single-token stop sequences so finished rows stop burning steps'
+    logprob accumulation and the host can trim deterministically.
+    """
+    b = logits.shape[0]
+    terminal = (eos_id,) + tuple(stop_ids)
+
+    def _is_terminal(tok):
+        hit = tok == terminal[0]
+        for t in terminal[1:]:
+            hit = hit | (tok == t)
+        return hit
+
     key0 = jax.random.fold_in(key, 0)
     tok0, lp0 = sample_token(logits, key0, temperature, sampler)
-    done0 = tok0 == eos_id
+    done0 = _is_terminal(tok0)
     # Logprob of a sampled token counts even if that token is EOS.
     carry0 = (tok0, cache, done0, lp0)
 
     def step(carry, i):
         tok, cache, done, lp_sum = carry
-        # Shared prefill => every row has the same fill length forever
+        # Uniform write: every row has the same fill length forever
         # (all start equal, all advance by one each step), so the cache
         # write can be a slice update instead of a scatter.
         logits, cache = decode_step(
-            cfg, params, tok[:, None], cache, uniform_write=shared_prefill
+            cfg, params, tok[:, None], cache, uniform_write=uniform_write
         )
         step_key = jax.random.fold_in(key, i + 1)
         next_tok, lp = sample_token(logits, step_key, temperature, sampler)
         next_tok = jnp.where(done, pad_id, next_tok)
         lp_sum = lp_sum + jnp.where(done, 0.0, lp)
-        next_done = done | (next_tok == eos_id)
+        next_done = done | _is_terminal(next_tok)
         # Emitted token for this scan slot is the PREVIOUS carry token:
         # slot i holds the (i+1)-th generated token.
         return (next_tok, cache, next_done, lp_sum), (next_tok, done)
@@ -182,4 +232,125 @@ def generate(
     all_toks = jnp.where(all_done_before, pad_id, all_toks)
     return GenerateOutput(
         tokens=all_toks, num_tokens=num, logprob_sum=lp_sum
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "max_new_tokens",
+        "sampler",
+        "eos_id",
+        "pad_id",
+        "cache_len",
+        "stop_ids",
+        "shared_suffix",
+    ),
+)
+def generate_from_prefix(
+    cfg: ModelConfig,
+    params: dict,
+    prefix_k: jnp.ndarray,
+    prefix_v: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    sampler: SamplerConfig = SamplerConfig(),
+    eos_id: int = 2,
+    pad_id: int = 0,
+    cache_len: int | None = None,
+    stop_ids: tuple[int, ...] = (),
+    shared_suffix: bool = False,
+) -> GenerateOutput:
+    """Generate continuing from a prefilled shared prompt prefix.
+
+    The TPU-native counterpart of radix/prefix caching in GPU servers:
+    a prompt prefix shared by many calls (few-shot headers, a debate's
+    question+transcript, consensus rubric preambles) is prefilled ONCE
+    at B=1 — its per-layer K/V (``prefix_k``/``prefix_v``,
+    [L, 1, P, Hkv, Dh] from :class:`~llm_consensus_tpu.models.cache.KVCache`)
+    is then broadcast into every later batch instead of being recomputed.
+    This program:
+
+    1. allocates a fresh [B, cache_len] bf16 cache and copies the prefix
+       into slots [0, Pb) of every row (a broadcast + slice update — pure
+       HBM traffic, no FLOPs);
+    2. runs the per-row suffixes ([B, S] right-padded ``tokens`` with
+       true ``lengths``) through one chunk forward at position offset
+       ``prefix_len`` (:func:`~llm_consensus_tpu.models.transformer.decode_chunk`
+       semantics — each suffix token attends the prefix plus its chunk
+       prefix);
+    3. decodes with the shared scan loop.
+
+    ``prefix_k``/``prefix_v`` may be right-padded past the true prefix:
+    their static width Pb is a BUCKET, and ``prefix_len`` (traced [],
+    int32) is the real token count — so distinct headers of similar
+    length share one compiled program instead of recompiling per prefix
+    length. Pad-slot garbage in [prefix_len, Pb) is never attended
+    (valid-length masking) and is progressively overwritten by decode
+    writes, the same convention as prefill padding.
+
+    Exactness-tested against :func:`generate` on the concatenated
+    prompts. bf16 cache only (the quant cache's head-major layout has no
+    chunk path); single device / data-replicated params.
+    """
+    from llm_consensus_tpu.models.transformer import _chunk_hidden, _unembed
+
+    b, s = tokens.shape
+    p = prefix_k.shape[2]  # bucket width Pb >= real prefix_len
+    if cache_len is None:
+        cache_len = p + s + max_new_tokens
+    if cache_len < p + s + max_new_tokens:
+        raise ValueError(
+            f"cache_len {cache_len} < prefix bucket {p} + suffix {s} "
+            f"+ max_new_tokens {max_new_tokens}"
+        )
+
+    # shared_suffix (static): all B rows carry the SAME suffix (N-way
+    # self-consistency fan-out) — run the suffix chunk once at B=1 and
+    # broadcast, like generate()'s shared_prefill.
+    cb = 1 if shared_suffix else b
+    cache = KVCache.create(cfg, cb, cache_len, dtype=prefix_k.dtype)
+    kb = jnp.broadcast_to(prefix_k, (prefix_k.shape[0], cb, *prefix_k.shape[2:]))
+    vb = jnp.broadcast_to(prefix_v, (prefix_v.shape[0], cb, *prefix_v.shape[2:]))
+    plen = jnp.asarray(prefix_len, jnp.int32)
+    cache = KVCache(
+        k=jax.lax.dynamic_update_slice(cache.k, kb, (0, 0, 0, 0, 0)),
+        v=jax.lax.dynamic_update_slice(cache.v, vb, (0, 0, 0, 0, 0)),
+        length=jnp.full((cb,), 1, jnp.int32) * plen,
+    )
+
+    hidden, cache = _chunk_hidden(cfg, params, tokens[:cb], cache)
+    last = jnp.clip(lengths[:cb] - 1, 0, s - 1)
+    x_last = hidden[jnp.arange(cb), last]  # [cb, D]
+    logits = _unembed(cfg, params, x_last)
+    if shared_suffix:
+        logits = jnp.broadcast_to(logits, (b, logits.shape[-1]))
+        cache = _broadcast_cache(cache, b).with_length(plen + lengths)
+    else:
+        # Suffix padding slots hold garbage k/v past each row's true
+        # length — masked out of decode attention and progressively
+        # overwritten, the same convention as prefill padding.
+        cache = cache.with_length(plen + lengths)
+
+    return _decode_loop(
+        cfg,
+        params,
+        logits,
+        cache,
+        key,
+        temperature,
+        sampler=sampler,
+        eos_id=eos_id,
+        pad_id=pad_id,
+        max_new_tokens=max_new_tokens,
+        # Shared suffix => every row starts at the same fill length, so
+        # decode cache writes compile to slice updates, not scatters.
+        uniform_write=shared_suffix,
+        stop_ids=stop_ids,
     )

@@ -1,0 +1,90 @@
+"""Host-side LRU of prefilled prompt prefixes (radix-style KV reuse).
+
+The consensus protocol re-sends the same prompt material constantly:
+every GSM8K problem shares the few-shot/instruction header, every debate
+round re-prefixes the question + transcript, an EM-vs-N sweep prefill's
+the identical prompt once per N. The reference pays a full remote call
+each time (``src/main.rs:82-86``); a local engine can do better — prefill
+a shared prefix ONCE at B=1, keep its per-layer K/V on device, and let
+:func:`llm_consensus_tpu.engine.generate.generate_from_prefix` broadcast
+it into every later batch.
+
+This module is the host bookkeeping only: an LRU keyed by the exact
+token-id tuple of the prefix, holding B=1 bf16 ``(k, v)`` buffers
+([L, 1, P, Hkv, Dh]) that live in device HBM. Eviction frees HBM via the
+normal jax buffer GC. Capacity is bounded both by entry count and by a
+byte budget so a long-header workload cannot silently eat the cache
+memory the decode batch needs.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+def _entry_bytes(k: jnp.ndarray, v: jnp.ndarray) -> int:
+    return k.size * k.dtype.itemsize + v.size * v.dtype.itemsize
+
+
+@dataclass
+class PrefixCacheStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class PrefixCache:
+    """LRU: token-id tuple -> (k, v) device buffers of a prefilled prefix."""
+
+    def __init__(self, max_entries: int = 8, max_bytes: int = 1 << 30):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self._entries: OrderedDict[tuple[int, ...], tuple] = OrderedDict()
+        self._bytes = 0
+        self.stats = PrefixCacheStats()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def get(self, key: tuple[int, ...]):
+        """(k, v) for the prefix, or None. Refreshes LRU order on hit."""
+        entry = self._entries.get(key)
+        if entry is None:
+            self.stats.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.stats.hits += 1
+        return entry
+
+    def put(self, key: tuple[int, ...], k: jnp.ndarray, v: jnp.ndarray):
+        """Insert a prefilled prefix; evicts LRU entries over budget."""
+        size = _entry_bytes(k, v)
+        if key in self._entries:
+            old = self._entries.pop(key)
+            self._bytes -= _entry_bytes(*old)
+        self._entries[key] = (k, v)
+        self._bytes += size
+        while len(self._entries) > self.max_entries or (
+            self._bytes > self.max_bytes and len(self._entries) > 1
+        ):
+            _, (ek, ev) = self._entries.popitem(last=False)
+            self._bytes -= _entry_bytes(ek, ev)
+            self.stats.evictions += 1
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0

@@ -101,6 +101,19 @@ _PROMPT = (
 )
 
 
+def few_shot_header(examples: list[Problem]) -> str:
+    """Worked-example block for k-shot CoT prompting (GSM8K convention).
+
+    Prepended to the prompt template's fixed header, it rides the
+    engine's prefix cache: the k exemplars are prefilled once for the
+    whole eval, not once per problem x N candidates.
+    """
+    parts = []
+    for ex in examples:
+        parts.append(f"Question: {ex.question}\nAnswer: {ex.answer}\n\n")
+    return "".join(parts)
+
+
 def evaluate_self_consistency(
     engine,
     problems: list[Problem],
@@ -110,27 +123,71 @@ def evaluate_self_consistency(
     max_new_tokens: int | None = None,
     method: str = "majority",
     prompt_template: str = _PROMPT,
+    few_shot: list[Problem] | None = None,
+    prefix_mode: str = "auto",
 ) -> EvalReport:
     """EM with N-way self-consistency.
 
     All N candidates of one problem run as ONE batched device program on
     the engine (the candidate axis = the mesh ``data`` axis). N=1 with
     temperature 0 degenerates to the greedy correctness baseline
-    (BASELINE.md config[0]).
+    (BASELINE.md config[0]). ``few_shot``: exemplar problems (disjoint
+    from ``problems``) prepended as a k-shot header.
+
+    ``prefix_mode``: "auto" rides the engine's prefix cache (header
+    prefilled once for the whole eval) only when the split provably
+    cannot change the token stream — byte-level tokenizer, and a
+    template whose only format field is a single ``{q}``. "force"
+    enables it for any tokenizer (merge-based BPE may tokenize the
+    head/suffix boundary differently from the concatenated prompt —
+    the standard prefix-caching caveat); "off" disables it.
     """
     correct = 0
     total_tokens = 0
     per_problem = []
+    # The template's fixed header (everything before {q}) is identical
+    # across problems and N-sweeps: engines with a prefix cache prefill
+    # it once and reuse its K/V for every problem (engine/prefix_cache).
+    # Head/tail splitting is only valid for templates whose one format
+    # field is {q}; anything fancier takes the plain .format path.
+    shots = few_shot_header(few_shot) if few_shot else ""
+    splittable = (
+        prompt_template.count("{q}") == 1
+        and prompt_template.count("{") == 1
+        and prompt_template.count("}") == 1
+    )
+    if prefix_mode not in ("auto", "force", "off"):
+        raise ValueError(f"unknown prefix_mode {prefix_mode!r}")
+    from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+
+    use_prefix = (
+        splittable
+        and prefix_mode != "off"
+        and hasattr(engine, "prefix_cache")
+        and (
+            prefix_mode == "force"
+            or isinstance(getattr(engine, "tokenizer", None), ByteTokenizer)
+        )
+    )
+    head, _, tail = prompt_template.partition("{q}")
     t0 = time.perf_counter()
     for i, prob in enumerate(problems):
-        prompt = prompt_template.format(q=prob.question)
         temps = [temperature if n > 1 else 0.0] * n
-        results = engine.generate_texts(
-            [prompt] * n,
-            temperatures=temps,
-            seed=seed + i,
-            max_new_tokens=max_new_tokens,
-        )
+        if use_prefix and shots + head:
+            results = engine.generate_texts(
+                [prob.question + tail] * n,
+                temperatures=temps,
+                seed=seed + i,
+                max_new_tokens=max_new_tokens,
+                prefix=shots + head,
+            )
+        else:
+            results = engine.generate_texts(
+                [shots + prompt_template.format(q=prob.question)] * n,
+                temperatures=temps,
+                seed=seed + i,
+                max_new_tokens=max_new_tokens,
+            )
         texts = [r.text for r in results]
         total_tokens += sum(r.num_tokens for r in results)
         if method == "majority":

@@ -368,3 +368,213 @@ def test_engine_rejects_prefill_chunk_with_kv_quant(tiny):
                 prefill_chunk=8, kv_quant=True,
             ),
         )
+
+
+# ---------------------------------------------------------------------------
+# Prefix caching (engine/prefix_cache.py + generate_from_prefix)
+# ---------------------------------------------------------------------------
+
+
+def test_generate_from_prefix_matches_concatenated(tiny):
+    """Prefix-continuation must equal plain generation on prefix+suffix."""
+    from llm_consensus_tpu.engine.generate import generate_from_prefix
+    from llm_consensus_tpu.models.cache import KVCache
+    from llm_consensus_tpu.models.transformer import prefill
+
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    prefix_txt = "Shared few-shot header. "
+    suffixes = ["What is 2+2?", "Name a color now."]
+
+    prefix_ids = tok.encode(prefix_txt)  # BOS + bytes
+    p = len(prefix_ids)
+    cache1 = KVCache.create(cfg, 1, p)
+    _, cache1 = prefill(
+        cfg, params,
+        jnp.asarray([prefix_ids], jnp.int32),
+        jnp.asarray([p], jnp.int32),
+        cache1,
+    )
+
+    suf = [tok.encode(s, add_bos=False) for s in suffixes]
+    s_max = max(len(x) for x in suf)
+    tokens = np.full((2, s_max), tok.pad_id, np.int32)
+    for i, ids in enumerate(suf):
+        tokens[i, : len(ids)] = ids
+    lengths = jnp.asarray([len(x) for x in suf], jnp.int32)
+
+    # Pad the prefix buffers past the true length: exercises the
+    # bucketed-prefix contract (prefix_len is the real count).
+    pad = ((0, 0), (0, 0), (0, 5), (0, 0), (0, 0))
+    out = generate_from_prefix(
+        cfg, params, jnp.pad(cache1.k, pad), jnp.pad(cache1.v, pad),
+        jnp.asarray(p, jnp.int32),
+        jnp.asarray(tokens), lengths,
+        jax.random.PRNGKey(0), jnp.zeros(2),
+        max_new_tokens=6,
+    )
+
+    # Plain path on the concatenated token streams.
+    full = [prefix_ids + x for x in suf]
+    f_max = max(len(x) for x in full)
+    ftokens = np.full((2, f_max), tok.pad_id, np.int32)
+    for i, ids in enumerate(full):
+        ftokens[i, : len(ids)] = ids
+    flengths = jnp.asarray([len(x) for x in full], jnp.int32)
+    want = generate(
+        cfg, params, jnp.asarray(ftokens), flengths,
+        jax.random.PRNGKey(0), jnp.zeros(2), max_new_tokens=6,
+    )
+    assert out.tokens.tolist() == want.tokens.tolist()
+    assert out.num_tokens.tolist() == want.num_tokens.tolist()
+    np.testing.assert_allclose(
+        out.logprob_sum, want.logprob_sum, rtol=2e-2, atol=2e-2
+    )
+
+
+def test_engine_prefix_matches_plain_and_caches(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(32, 64), batch_buckets=(1, 2, 4), max_new_tokens=8
+        ),
+    )
+    prefix = "Instructions: answer briefly. "
+    prompts = ["Q: 2+2? A:", "Q: sky color? A:"]
+    want = [r.text for r in eng.generate_texts([prefix + p for p in prompts])]
+    got1 = [r.text for r in eng.generate_texts(prompts, prefix=prefix)]
+    assert eng.prefix_cache.stats.misses == 1
+    got2 = [r.text for r in eng.generate_texts(prompts, prefix=prefix)]
+    assert eng.prefix_cache.stats.hits == 1
+    assert got1 == want
+    assert got2 == want
+
+
+def test_engine_prefix_kv_quant_falls_back(tiny):
+    """Quant-KV engines still honor the prefix arg (concatenated path)."""
+    cfg, params = tiny
+    plain = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(32,), batch_buckets=(1, 2), max_new_tokens=6,
+            kv_quant=True,
+        ),
+    )
+    prefix, prompts = "Header text. ", ["suffix one", "suffix two longer"]
+    want = [r.text for r in plain.generate_texts([prefix + p for p in prompts])]
+    got = [r.text for r in plain.generate_texts(prompts, prefix=prefix)]
+    assert got == want
+    assert len(plain.prefix_cache) == 0  # bypassed, not cached
+
+
+def test_prefix_cache_lru_and_budgets():
+    from llm_consensus_tpu.engine.prefix_cache import PrefixCache
+
+    pc = PrefixCache(max_entries=2)
+    k = jnp.zeros((1, 1, 4, 1, 2), jnp.bfloat16)
+    pc.put((1,), k, k)
+    pc.put((2,), k, k)
+    assert pc.get((1,)) is not None  # refresh (1,)
+    pc.put((3,), k, k)  # evicts (2,)
+    assert pc.get((2,)) is None
+    assert pc.get((1,)) is not None and pc.get((3,)) is not None
+    assert pc.stats.evictions == 1
+
+    tiny_budget = PrefixCache(max_entries=8, max_bytes=4 * k.size)
+    tiny_budget.put((1,), k, k)
+    tiny_budget.put((2,), k, k)  # 2 entries * 2k bytes > budget -> evict
+    assert len(tiny_budget) == 1
+    assert tiny_budget.nbytes <= 4 * k.size
+
+
+# ---------------------------------------------------------------------------
+# Stop sequences
+# ---------------------------------------------------------------------------
+
+
+def test_stop_ids_terminate_decode_like_eos(tiny):
+    """A single-token stop halts the row: pads after, no logprob accrual."""
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(16,), batch_buckets=(1,), max_new_tokens=8
+        ),
+    )
+    free = [r for r in eng.generate_texts(["count: one two"])][0]
+    assert free.num_tokens > 1
+    # Stop on the first character the unstopped run emitted.
+    first_char = free.text[:1]
+    if not first_char:
+        pytest.skip("model emitted EOS immediately")
+    stopped = eng.generate_texts(["count: one two"], stop=[first_char])[0]
+    assert stopped.text == ""  # trimmed at the stop
+    assert stopped.num_tokens <= 2  # device loop ended at the stop token
+
+
+def test_stop_string_trims_host_side(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(16,), batch_buckets=(1,), max_new_tokens=8
+        ),
+    )
+    free = eng.generate_texts(["hello there"])[0]
+    if len(free.text) < 3:
+        pytest.skip("output too short to split")
+    stop = free.text[1:3]  # multi-char stop (two byte tokens)
+    trimmed = eng.generate_texts(["hello there"], stop=[stop])[0]
+    assert trimmed.text == free.text[:1]
+    assert stop not in trimmed.text
+
+
+def test_engine_prefix_shared_suffix_fanout(tiny):
+    """N identical suffixes under a prefix == plain shared-prefill run."""
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(32, 64), batch_buckets=(1, 2, 4), max_new_tokens=6
+        ),
+    )
+    prefix, q = "Shared header: ", "what is 2+2?"
+    want = [r.text for r in eng.generate_texts([prefix + q] * 4, seed=7)]
+    got = [r.text for r in eng.generate_texts([q] * 4, prefix=prefix, seed=7)]
+    assert got == want
+
+
+def test_engine_prefix_short_header_keeps_token_budget(tiny):
+    """A short header must not inflate to a coarse seq bucket and eat
+    the generation budget (pow2 prefix bucketing regression)."""
+    cfg, params = tiny  # max_seq_len=128
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(64,), batch_buckets=(1,), max_new_tokens=8
+        ),
+    )
+    plain = eng.generate_texts(["Header. Q: hi A:"])[0]
+    out = eng.generate_texts(["Q: hi A:"], prefix="Header. ")[0]
+    assert out.num_tokens == plain.num_tokens
+    assert out.text == plain.text
+
+
+def test_engine_prefix_long_header_falls_back(tiny):
+    """A header too long for the suffix to fit must fall back to the
+    plain concatenated path (tail-keeping left truncation), not crush
+    the question — and must not prefill/cache the hopeless prefix."""
+    cfg, params = tiny  # max_seq_len=128
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(64, 128), batch_buckets=(1,), max_new_tokens=4
+        ),
+    )
+    prefix = "H" * 110
+    q = "Q" * 50  # 110 + 50 + bos > 128
+    want = eng.generate_texts([prefix + q])[0].text
+    got = eng.generate_texts([q], prefix=prefix)[0].text
+    assert got == want
+    assert len(eng.prefix_cache) == 0

@@ -149,3 +149,39 @@ def test_em_rises_with_n_on_bundled_data():
         em[n] = report.em
     assert em[9] > em[1]
     assert em[9] >= 0.8  # majority of 9 at p=.6 is right ~73%+ of the time
+
+
+def test_eval_rides_prefix_cache_across_problems():
+    """One header prefill serves every problem and both sweep points."""
+    from llm_consensus_tpu.eval.gsm8k import (
+        evaluate_self_consistency,
+        few_shot_header,
+        synthetic_problems,
+    )
+
+    # Enough context for a 2-shot header (~300 byte tokens) + question.
+    cfg = get_config("test-tiny").with_(max_seq_len=1024)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = InferenceEngine(
+        cfg,
+        params,
+        engine_config=EngineConfig(
+            max_new_tokens=6, seq_buckets=(64, 128, 256),
+            batch_buckets=(1, 2, 4),
+        ),
+    )
+    shots = synthetic_problems(2, seed=99)
+    problems = synthetic_problems(3, seed=1)
+    r1 = evaluate_self_consistency(
+        engine, problems, n=2, temperature=0.8, few_shot=shots
+    )
+    assert engine.prefix_cache.stats.misses == 1
+    assert engine.prefix_cache.stats.hits == len(problems) - 1
+    # Second sweep point (different N): header K/V still cached.
+    evaluate_self_consistency(
+        engine, problems, n=4, temperature=0.8, few_shot=shots
+    )
+    assert engine.prefix_cache.stats.misses == 1
+    assert r1.n_problems == 3
+    hdr = few_shot_header(shots)
+    assert all(f"{ex.question}" in hdr for ex in shots)
