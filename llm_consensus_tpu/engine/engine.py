@@ -68,6 +68,12 @@ class EngineConfig:
     # and their K/V reused across calls. Entry/byte budgets bound HBM.
     prefix_cache_entries: int = 8
     prefix_cache_bytes: int = 1 << 30
+    # Single-chip experiment: per-layer weight buffers + python-unrolled
+    # layer loop (models.transformer.unstack_blocks). Measured SLOWER
+    # than the stacked scan on v5e at bench shapes (the scan pipelines
+    # weight streaming; 162 sequential pallas calls don't) — off by
+    # default, kept for experimentation on other topologies.
+    unroll_layers: bool = False
 
 
 @dataclass
@@ -128,6 +134,13 @@ class InferenceEngine:
         # Optional draft model for generate_texts_speculative: a
         # (config, params) pair sharing this model's tokenizer/vocab.
         self.draft = draft
+        if mesh is None and self.config.unroll_layers:
+            from llm_consensus_tpu.models.transformer import unstack_blocks
+
+            self.params = unstack_blocks(self.params)
+            if self.draft is not None:
+                d_cfg, d_params = self.draft
+                self.draft = (d_cfg, unstack_blocks(d_params))
         from llm_consensus_tpu.engine.prefix_cache import PrefixCache
 
         self.prefix_cache = PrefixCache(
@@ -165,7 +178,7 @@ class InferenceEngine:
         max_prompt = min(self.config.seq_buckets[-1], self.cfg.max_seq_len - 1)
         if max_cap is not None:
             max_prompt = min(max_prompt, max_cap)
-        native = self._native_encode(prompts, max_prompt) if add_bos else None
+        native = self._native_encode(prompts, max_prompt, add_bos=add_bos)
         if native is not None:
             enc_tokens, enc_lengths = native
         else:
@@ -189,7 +202,7 @@ class InferenceEngine:
         lengths[len(prompts) :] = 1
         return tokens, lengths, len(prompts)
 
-    def _native_encode(self, prompts, max_prompt):
+    def _native_encode(self, prompts, max_prompt, add_bos: bool = True):
         """Batch-encode via the native runtime when the tokenizer is the
         byte tokenizer and libconsensus_rt is available (one C pass
         instead of a Python loop per request)."""
@@ -200,7 +213,9 @@ class InferenceEngine:
 
             if not available():
                 return None
-            return batch_encode(prompts, max_len=max_prompt, add_bos=True)
+            return batch_encode(
+                prompts, max_len=max_prompt, add_bos=add_bos
+            )
         except Exception:  # noqa: BLE001 - any native issue -> python path
             return None
 
@@ -311,8 +326,8 @@ class InferenceEngine:
                 r.text = r.text[:cut]
         return results
 
-    def _prefix_kv(self, prefix: str):
-        """(true_len, k, v) for the prefilled prefix (cached).
+    def _prefix_kv(self, ids: list[int]):
+        """(k, v) for the prefilled prefix token ids (cached).
 
         The stored buffers are right-padded to the pow2 bucket of the
         true length (bounds distinct compiled programs at log2(ctx) and
@@ -323,12 +338,11 @@ class InferenceEngine:
         from llm_consensus_tpu.models.cache import KVCache
 
         max_prefix = self.cfg.max_seq_len - 2  # room for >=1 suffix token
-        ids = self.tokenizer.encode(prefix)[-max_prefix:]
         key = tuple(ids)
         p = len(ids)
         hit = self.prefix_cache.get(key)
         if hit is not None:
-            return key, p, hit
+            return hit
         pb = min(1 << max(p - 1, 0).bit_length(), max_prefix)
         cache = KVCache.create(self.cfg, 1, pb)
         tokens = jnp.asarray(
@@ -346,7 +360,7 @@ class InferenceEngine:
             )
         entry = (cache.k, cache.v)
         self.prefix_cache.put(key, *entry)
-        return key, p, entry
+        return entry
 
     def _generate_with_prefix(
         self, prompts, prefix, temperatures, seed, max_new_tokens, sampler,
@@ -354,18 +368,18 @@ class InferenceEngine:
     ) -> list[EngineResult]:
         from llm_consensus_tpu.engine.generate import generate_from_prefix
 
-        # Suffixes that cannot sit whole after the prefix (or that exceed
-        # the configured chunked-prefill bound) take the plain
-        # concatenated path instead: it left-truncates keeping the tail
-        # of prefix+question and honors prefill_chunk — silently
+        # One encode pass for everything: prefix ids feed both the fit
+        # check and the prefix cache; suffix encodings feed both the fit
+        # check and the batch (native byte-tokenizer batch path when
+        # available). Suffixes that cannot sit whole after the prefix
+        # (or that exceed the configured chunked-prefill bound) take the
+        # plain concatenated path instead: it left-truncates keeping the
+        # tail of prefix+question and honors prefill_chunk — silently
         # crushing the question to fit a long header would be worse than
         # losing the cache reuse.
-        suffix_lens = [
-            len(self.tokenizer.encode(q, add_bos=False)) for q in prompts
-        ]
-        p_est = min(
-            len(self.tokenizer.encode(prefix)), self.cfg.max_seq_len - 2
-        )
+        ctx = self.cfg.max_seq_len
+        prefix_ids = self.tokenizer.encode(prefix)[-(ctx - 2) :]
+        p = len(prefix_ids)
 
         def _fallback():
             log.debug("prefix cache bypassed (suffix does not fit)")
@@ -378,26 +392,42 @@ class InferenceEngine:
                 stop=stop,
             )
 
-        if p_est + max(suffix_lens) + 1 > self.cfg.max_seq_len:
+        native = self._native_encode(prompts, ctx, add_bos=False)
+        if native is not None:
+            enc_tokens, enc_lengths = native
+            suf = None
+        else:
+            suf = [self.tokenizer.encode(q, add_bos=False)[:ctx] for q in prompts]
+            enc_lengths = np.array([len(x) for x in suf], np.int32)
+        longest = int(enc_lengths.max()) if len(prompts) else 0
+        if min(int(enc_lengths.min()), longest) < 1:
+            return _fallback()  # an empty suffix: prefix alone, plain path
+        if p + longest + 1 > ctx:
             return _fallback()
-        key, p, (pk, pv) = self._prefix_kv(prefix)
-        tokens, lengths, n_real = self._prepare(
-            prompts, add_bos=False, max_cap=self.cfg.max_seq_len - p - 1
-        )
-        if int(lengths[:n_real].min()) < 1:
-            raise ValueError("empty suffix under a prefix; fold it into one")
-        b, s = tokens.shape
+        s = min(_next_bucket(longest, self.config.seq_buckets), ctx - p - 1)
+        s = max(s, longest)
         if self.config.prefill_chunk and s > self.config.prefill_chunk:
             return _fallback()  # suffix chunk would unbound prefill memory
+        pk, pv = self._prefix_kv(prefix_ids)
+        b = _next_bucket(len(prompts), self.config.batch_buckets)
+        tokens = np.full((b, s), self.tokenizer.pad_id, np.int32)
+        if suf is None:
+            w = min(s, enc_tokens.shape[1])
+            tokens[: len(prompts), :w] = enc_tokens[:, :w]
+        else:
+            for i, ids in enumerate(suf):
+                tokens[i, : len(ids)] = ids
+        lengths = np.ones((b,), np.int32)  # dummy rows: length 1
+        lengths[: len(prompts)] = enc_lengths
+        n_real = len(prompts)
         # The stored prefix is padded to the pow2 bucket of its true
         # length (zero-copy on hit); the true length rides as a traced
-        # scalar. Token budgets below clamp on the BUCKETED widths —
-        # near the context limit this is a few tokens more conservative
-        # than the true headroom, the same bucket conservatism as the
-        # plain path.
+        # scalar, and the token budget below is charged at the TRUE
+        # prefix length — only the suffix term carries bucket slack,
+        # the same conservatism as the plain path.
         pb = pk.shape[2]
-        if pb + s > self.cfg.max_seq_len:
-            pb = self.cfg.max_seq_len - s
+        if pb + s > ctx:
+            pb = ctx - s
             if pb < p:
                 return _fallback()  # bucket rounding left no room
             pk, pv = pk[:, :, :pb], pv[:, :, :pb]
@@ -405,7 +435,7 @@ class InferenceEngine:
         if temperatures is not None:
             temps[:n_real] = np.asarray(temperatures, np.float32)
         mnt = max_new_tokens or self.config.max_new_tokens
-        mnt = max(1, min(mnt, self.cfg.max_seq_len - pb - s))
+        mnt = max(1, min(mnt, ctx - p - s))
         # Identical suffixes (self-consistency fan-out under a cached
         # header): chunk the suffix once at B=1 and broadcast.
         shared = n_real == b and len(set(prompts)) == 1 and b > 1

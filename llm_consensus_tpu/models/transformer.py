@@ -406,6 +406,33 @@ def _block(
     return x, new_kv
 
 
+def unstack_blocks(params: dict) -> dict:
+    """Per-layer weight buffers: "blocks" [L, ...] -> tuple of L dicts.
+
+    Makes :func:`_run_layers` unroll a python loop over separate
+    per-layer buffers instead of scanning the stacked layer axis. In
+    principle this avoids materializing each layer's weight slice as a
+    Pallas-operand copy; MEASURED on v5e at bench shapes it is a net
+    LOSS (default bench config 24.8k -> 22.8k tok/s/chip, bf16-cache
+    pallas path ~10x worse): the scan pipelines weight streaming across
+    layers, and per-layer cache slices still materialize. Kept as an
+    opt-in experiment (``EngineConfig.unroll_layers``) for other
+    topologies; the cache-copy problem the unroll targeted is fixed
+    inside the scan itself (cache leaves ride the scan carry, see
+    ``_run_layers``). Training and sharded paths always use the stacked
+    layout (compile time, pspecs).
+    """
+    blocks = params["blocks"]
+    if isinstance(blocks, (list, tuple)):
+        return params
+    n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    out = dict(params)
+    out["blocks"] = tuple(
+        jax.tree.map(lambda a: a[i], blocks) for i in range(n_layers)
+    )
+    return out
+
+
 def _run_layers(
     cfg: ModelConfig,
     params: dict,
@@ -420,8 +447,16 @@ def _run_layers(
     uniform_write: bool = False,
     mesh=None,
 ):
-    """lax.scan over the stacked layer axis."""
+    """lax.scan over the stacked layer axis (python-unrolled loop when
+    ``params["blocks"]`` is a tuple of per-layer dicts — see
+    :func:`unstack_blocks`)."""
     blocks = params["blocks"]
+
+    if isinstance(blocks, (list, tuple)):
+        return _run_layers_unrolled(
+            cfg, blocks, x, cos, sin, cache, mode, valid_len, positions,
+            remat=remat, uniform_write=uniform_write, mesh=mesh,
+        )
 
     if mode == "full":
 
@@ -442,29 +477,102 @@ def _run_layers(
     else:
         kv_leaves = (cache.k, cache.v)
 
+    # Cache leaves ride in the scan CARRY and are updated in place at
+    # the layer index — NOT as scanned xs with stacked ys outputs. The
+    # ys form allocates a fresh stacked cache buffer every call, which
+    # in the token-decode loop defeats the outer scan's carry aliasing
+    # and copies the ENTIRE cache each step (profiler-measured ~1 GB of
+    # pure copy per step at bench shapes on v5e).
     def body(carry, layer_in):
-        p = layer_in[0]
+        y, *leaves = carry
+        layer_idx, p = layer_in
+        layer_kv = tuple(
+            jax.lax.dynamic_index_in_dim(
+                leaf, layer_idx, axis=0, keepdims=False
+            )
+            for leaf in leaves
+        )
         y, new_kv = _block(
             cfg,
             p,
-            carry,
+            y,
             cos,
             sin,
-            layer_in[1:],
+            layer_kv,
             mode,
             valid_len,
             positions,
             uniform_write=uniform_write,
             mesh=mesh,
         )
-        return y, new_kv
+        leaves = tuple(
+            jax.lax.dynamic_update_index_in_dim(leaf, nk, layer_idx, axis=0)
+            for leaf, nk in zip(leaves, new_kv)
+        )
+        return (y, *leaves), None
 
     if remat:
         body = jax.checkpoint(body)
-    x, new_leaves = jax.lax.scan(body, x, (blocks, *kv_leaves))
+    layer_ids = jnp.arange(len(jax.tree_util.tree_leaves(blocks)[0]))
+    (x, *new_leaves), _ = jax.lax.scan(
+        body, (x, *kv_leaves), (layer_ids, blocks)
+    )
     if isinstance(cache, QuantKVCache):
         return x, QuantKVCache(*new_leaves, length=cache.length)
     return x, KVCache(k=new_leaves[0], v=new_leaves[1], length=cache.length)
+
+
+def _run_layers_unrolled(
+    cfg: ModelConfig,
+    blocks,
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    cache: KVCache | None,
+    mode: str,
+    valid_len: jnp.ndarray | None,
+    positions: jnp.ndarray | None,
+    remat: bool = False,
+    uniform_write: bool = False,
+    mesh=None,
+):
+    """Python-unrolled layer loop over per-layer weight buffers.
+
+    Cache leaves are sliced/written at STATIC layer indices, so XLA
+    keeps every update in place on the carried buffers (no per-step
+    cache or weight copies — the point of :func:`unstack_blocks`).
+    """
+    step = _block
+    if remat:
+        step = jax.checkpoint(
+            _block, static_argnums=(0, 6), static_argnames=("uniform_write",)
+        )
+
+    if mode == "full":
+        for p in blocks:
+            x, _ = step(
+                cfg, p, x, cos, sin, None, "full", None, positions, mesh=mesh
+            )
+        return x, cache
+
+    quant = isinstance(cache, QuantKVCache)
+    leaves = (
+        (cache.k_q, cache.v_q, cache.k_scale, cache.v_scale)
+        if quant
+        else (cache.k, cache.v)
+    )
+    for i, p in enumerate(blocks):
+        layer_kv = tuple(leaf[i] for leaf in leaves)
+        x, new_kv = step(
+            cfg, p, x, cos, sin, layer_kv, mode, valid_len, positions,
+            uniform_write=uniform_write, mesh=mesh,
+        )
+        leaves = tuple(
+            leaf.at[i].set(nk) for leaf, nk in zip(leaves, new_kv)
+        )
+    if quant:
+        return x, QuantKVCache(*leaves, length=cache.length)
+    return x, KVCache(k=leaves[0], v=leaves[1], length=cache.length)
 
 
 def _unembed(cfg: ModelConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:

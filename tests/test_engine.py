@@ -578,3 +578,41 @@ def test_engine_prefix_long_header_falls_back(tiny):
     got = eng.generate_texts([q], prefix=prefix)[0].text
     assert got == want
     assert len(eng.prefix_cache) == 0
+
+
+def test_engine_prefix_long_header_keeps_full_budget(tiny):
+    """The token budget must be charged at the TRUE prefix length, not
+    its pow2 bucket — a long header with ample context previously
+    collapsed generation to 1 token."""
+    cfg, params = tiny
+    cfg = cfg.with_(max_seq_len=4096)
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(64, 1024, 2048), batch_buckets=(1,),
+            max_new_tokens=8,
+        ),
+    )
+    prefix = "H" * 600  # 601 ids -> pow2 bucket 1024
+    q = "Q" * 29
+    want = eng.generate_texts([prefix + q])[0]
+    got = eng.generate_texts([q], prefix=prefix)[0]
+    assert got.text == want.text
+    assert got.num_tokens == want.num_tokens
+    assert got.num_tokens > 1
+
+
+def test_engine_prefix_empty_suffix_falls_back(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(64,), batch_buckets=(1, 2), max_new_tokens=4
+        ),
+    )
+    prefix = "A header. "
+    want = [r.text for r in eng.generate_texts([prefix + "", prefix + "q"])]
+    got = [
+        r.text for r in eng.generate_texts(["", "q"], prefix=prefix)
+    ]
+    assert got == want

@@ -94,3 +94,28 @@ def test_quant_cache_shapes():
     assert cache.k_scale.shape == (CFG.n_layers, 3, CFG.n_kv_heads, 32)
     assert cache.max_len == 32
     assert int(cache.advanced(2).length[0]) == 2
+
+
+def test_q8_kernel_per_head_fallback_matches_row_kernel(monkeypatch):
+    """Both dispatch branches (batch-row program vs per-(batch, head)
+    program) must agree with the jnp reference."""
+    import llm_consensus_tpu.ops.pallas.attention as pattn
+
+    b, hkv, g, s, d = 2, 2, 2, 16, 8
+    key = jax.random.PRNGKey(3)
+    q = jax.random.normal(key, (b, 1, hkv * g, d), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, hkv, s, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, hkv, s, d))
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+    valid = jnp.asarray([7, 12], jnp.int32)
+    ref = decode_attention_quant(q, k_q, k_s, v_q, v_s, valid)
+    row = flash_decode_attention_q8(
+        q, k_q, k_s, v_q, v_s, valid, interpret=True
+    )
+    monkeypatch.setattr(pattn, "_ROW_KERNEL_MAX_KV_BYTES", 0)
+    per_head = flash_decode_attention_q8(
+        q, k_q, k_s, v_q, v_s, valid, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(row), np.asarray(ref), atol=2e-2, rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(per_head), np.asarray(ref), atol=2e-2, rtol=2e-2)

@@ -201,3 +201,48 @@ def test_param_count_plausible():
     tiny_cfg = get_config("test-tiny")
     params = init_params(tiny_cfg, jax.random.PRNGKey(0))
     assert param_count(params) > 0
+
+
+def test_unstacked_blocks_match_stacked():
+    """unstack_blocks must not change any output: forward, generate on
+    both cache types (the single-chip decode fast path's contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.engine.generate import generate
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import (
+        forward,
+        init_params,
+        unstack_blocks,
+    )
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    flat = unstack_blocks(params)
+    assert isinstance(flat["blocks"], tuple) and len(flat["blocks"]) == cfg.n_layers
+    assert unstack_blocks(flat) is flat  # idempotent
+
+    # Unrolled layers compile to a differently-fused program, so bf16
+    # rounding differs in the last bits — allclose, not bit-equal.
+    import numpy as np
+
+    tokens = jnp.array([[5, 9, 13, 17, 2, 0, 0, 0]], jnp.int32)
+    f1 = forward(cfg, params, tokens)
+    f2 = forward(cfg, flat, tokens)
+    np.testing.assert_allclose(f1, f2, rtol=2e-2, atol=2e-2)
+
+    lengths = jnp.array([5], jnp.int32)
+    for kv_quant in (False, True):
+        g1 = generate(
+            cfg, params, tokens, lengths, jax.random.PRNGKey(1),
+            jnp.zeros(1), max_new_tokens=6, kv_quant=kv_quant,
+        )
+        g2 = generate(
+            cfg, flat, tokens, lengths, jax.random.PRNGKey(1),
+            jnp.zeros(1), max_new_tokens=6, kv_quant=kv_quant,
+        )
+        assert g1.tokens.tolist() == g2.tokens.tolist()
+        np.testing.assert_allclose(
+            g1.logprob_sum, g2.logprob_sum, rtol=2e-2, atol=2e-2
+        )
