@@ -101,6 +101,53 @@ def _attn_decode(cfg: ModelConfig, q, k_cache, v_cache, valid_len):
     )
 
 
+_STACKED_DECODE = False
+
+
+def set_stacked_decode(enabled: bool) -> None:
+    """Toggle the stacked-cache decode path (see ``_run_layers``).
+
+    The flag is read at TRACE time, so already-compiled decode programs
+    would silently keep their old path — the setter clears the jit
+    caches so the next call really recompiles with the new setting.
+    """
+    global _STACKED_DECODE
+    _STACKED_DECODE = enabled
+    jax.clear_caches()
+
+
+def _attn_decode_quant_stacked(
+    cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len, layer
+):
+    """Decode attention over ONE layer of the stacked int8 cache.
+
+    k_q/v_q: [L, B, Hkv, S, D]; k_s/v_s: [L, B, Hkv, S]; ``layer`` is a
+    traced index. The Pallas path reads the stack in place (scalar
+    prefetch); the jnp fallback slices the layer (XLA fuses the slice
+    into the dequant + einsum).
+    """
+    use_kernel = (
+        cfg.use_pallas and jax.device_count() == 1 and cfg.sliding_window == 0
+    )
+    if use_kernel:
+        from llm_consensus_tpu.ops.pallas import (
+            flash_decode_attention_q8_stacked,
+        )
+
+        return flash_decode_attention_q8_stacked(
+            q, k_q, k_s, v_q, v_s, valid_len, layer
+        )
+    from llm_consensus_tpu.ops.attention import decode_attention_quant
+
+    def sl(a):
+        return jax.lax.dynamic_index_in_dim(a, layer, 0, keepdims=False)
+
+    return decode_attention_quant(
+        q, sl(k_q), sl(k_s), sl(v_q), sl(v_s), valid_len,
+        window=cfg.sliding_window,
+    )
+
+
 def _attn_decode_quant(cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len):
     """int8-cache decode attention: the Pallas kernel reads int8 straight
     from HBM (the whole point of the quantized cache) but pallas_call is
@@ -350,7 +397,40 @@ def _block(
         b = x.shape[0]
         batch_idx = jnp.arange(b)
         # valid_len is the pre-write fill length; write the new token there.
-        if len(kv_layer) == 2:
+        if isinstance(kv_layer[0], str) and kv_layer[0] == "stacked":
+            # Quant cache, WHOLE stacked buffers + traced layer index:
+            # the new token's k/v is written into the stack, and decode
+            # attention reads the stack directly (scalar-prefetch kernel
+            # — no per-layer cache slice materialization).
+            _, (kq_f, vq_f, ks_f, vs_f), layer_idx = kv_layer
+            kq1, ks1 = quantize_kv(k[:, 0])  # [B,Hkv,D] / [B,Hkv]
+            vq1, vs1 = quantize_kv(v[:, 0])
+            if uniform_write:
+                pos0 = valid_len[0]
+                zero = jnp.zeros((), pos0.dtype)
+                li = layer_idx.astype(pos0.dtype)
+                kq_f = jax.lax.dynamic_update_slice(
+                    kq_f, kq1[None, :, :, None, :], (li, zero, zero, pos0, zero)
+                )
+                vq_f = jax.lax.dynamic_update_slice(
+                    vq_f, vq1[None, :, :, None, :], (li, zero, zero, pos0, zero)
+                )
+                ks_f = jax.lax.dynamic_update_slice(
+                    ks_f, ks1[None, :, :, None], (li, zero, zero, pos0)
+                )
+                vs_f = jax.lax.dynamic_update_slice(
+                    vs_f, vs1[None, :, :, None], (li, zero, zero, pos0)
+                )
+            else:
+                kq_f = kq_f.at[layer_idx, batch_idx, :, valid_len].set(kq1)
+                vq_f = vq_f.at[layer_idx, batch_idx, :, valid_len].set(vq1)
+                ks_f = ks_f.at[layer_idx, batch_idx, :, valid_len].set(ks1)
+                vs_f = vs_f.at[layer_idx, batch_idx, :, valid_len].set(vs1)
+            new_kv = (kq_f, vq_f, ks_f, vs_f)
+            attn = _attn_decode_quant_stacked(
+                cfg, q, kq_f, ks_f, vq_f, vs_f, valid_len + 1, layer_idx
+            )
+        elif len(kv_layer) == 2:
             k_l, v_l = kv_layer
             if uniform_write:
                 pos0 = valid_len[0]
@@ -482,10 +562,42 @@ def _run_layers(
     # ys form allocates a fresh stacked cache buffer every call, which
     # in the token-decode loop defeats the outer scan's carry aliasing
     # and copies the ENTIRE cache each step (profiler-measured ~1 GB of
-    # pure copy per step at bench shapes on v5e).
-    def body(carry, layer_in):
+    # pure copy per step at bench shapes on v5e). Weights are NOT
+    # scanned either: per-layer views are built from the closed-over
+    # stack — quantized matmul weights as lazy ``StackedQuant`` views
+    # (the Pallas kernel indexes the resident stack via scalar prefetch
+    # instead of forcing a per-layer slice copy), everything else as a
+    # dynamic_index XLA fuses into its consumer.
+    # Quant-cache decode via the WHOLE stacked cache + layer index (the
+    # token write and attention read happen on the resident buffers with
+    # no per-layer slice or write-back). MEASURED SLOWER than
+    # slice+row-kernel on v5e at bench shapes (24.7k vs 25.5k tok/s/chip
+    # — the materialized slice feeds the row kernel with better DMA
+    # locality than the scalar-prefetch 5-d blocks) and its standalone
+    # compile is pathologically slow; opt-in via set_stacked_decode for
+    # experimentation on other topologies.
+    stacked_decode = (
+        _STACKED_DECODE and mode == "decode" and isinstance(cache, QuantKVCache)
+    )
+
+    def body(carry, layer_idx):
         y, *leaves = carry
-        layer_idx, p = layer_in
+        p = _layer_view(blocks, layer_idx)
+        if stacked_decode:
+            y, new_leaves = _block(
+                cfg,
+                p,
+                y,
+                cos,
+                sin,
+                ("stacked", tuple(leaves), layer_idx),
+                mode,
+                valid_len,
+                positions,
+                uniform_write=uniform_write,
+                mesh=mesh,
+            )
+            return (y, *new_leaves), None
         layer_kv = tuple(
             jax.lax.dynamic_index_in_dim(
                 leaf, layer_idx, axis=0, keepdims=False
@@ -514,12 +626,34 @@ def _run_layers(
     if remat:
         body = jax.checkpoint(body)
     layer_ids = jnp.arange(len(jax.tree_util.tree_leaves(blocks)[0]))
-    (x, *new_leaves), _ = jax.lax.scan(
-        body, (x, *kv_leaves), (layer_ids, blocks)
-    )
+    (x, *new_leaves), _ = jax.lax.scan(body, (x, *kv_leaves), layer_ids)
     if isinstance(cache, QuantKVCache):
         return x, QuantKVCache(*new_leaves, length=cache.length)
     return x, KVCache(k=new_leaves[0], v=new_leaves[1], length=cache.length)
+
+
+def _layer_view(blocks: dict, layer_idx) -> dict:
+    """One layer's params from the stacked blocks, sliced lazily.
+
+    int8 ``QuantizedTensor`` stacks become :class:`StackedQuant` views
+    (consumed by ``ops.quant.matmul``'s scalar-prefetch kernel without
+    materializing the slice); every other leaf is a ``dynamic_index``
+    that XLA fuses into its consumer.
+    """
+    from llm_consensus_tpu.ops.quant import QuantizedTensor, StackedQuant
+
+    view = {}
+    for name, leaf in blocks.items():
+        if isinstance(leaf, QuantizedTensor) and leaf.q.ndim == 3:
+            view[name] = StackedQuant(full=leaf, layer=layer_idx)
+        else:
+            view[name] = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, layer_idx, 0, keepdims=False
+                ),
+                leaf,
+            )
+    return view
 
 
 def _run_layers_unrolled(

@@ -16,6 +16,7 @@ from llm_consensus_tpu.ops.pallas.attention import (
     flash_causal_attention,
     flash_decode_attention,
     flash_decode_attention_q8,
+    flash_decode_attention_q8_stacked,
 )
 from llm_consensus_tpu.ops.pallas.norms import fused_rms_norm
 from llm_consensus_tpu.ops.pallas.quant_matmul import quant_matmul_2d
@@ -24,6 +25,7 @@ __all__ = [
     "flash_causal_attention",
     "flash_decode_attention",
     "flash_decode_attention_q8",
+    "flash_decode_attention_q8_stacked",
     "fused_rms_norm",
     "quant_matmul_2d",
 ]

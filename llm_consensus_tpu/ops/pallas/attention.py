@@ -178,6 +178,36 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _q8_attend(q, kq, ks_row, vq, vs_row, mask, scale: float):
+    """Shared q8 decode-attention arithmetic for one (row, kv-head).
+
+    q: [G, D]; kq/vq: [S, D] int8; ks_row/vs_row: [1, S] f32;
+    mask: [1, S] bool. Returns [G, D] f32. All three q8 decode kernels
+    (per-head grid, batch-row grid, stacked-cache grid) call this — the
+    numerics live in exactly one place.
+
+    Dequant is linear: fold the per-slot scales into the [G, S]
+    scores/probs instead of scaling the [S, D] K/V slabs (D-times
+    fewer VPU ops; int8 slabs feed the MXU after a bare cast).
+    """
+    scores = jax.lax.dot_general(
+        q.astype(jnp.float32),
+        kq.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * (ks_row * scale)  # [G, S] * [1, S]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jax.lax.dot_general(
+        p * vs_row,  # [G, S] * [1, S]
+        vq.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [G, D]
+
+
 def _decode_q8_kernel(
     len_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, o_ref, *, scale: float
 ):
@@ -190,33 +220,13 @@ def _decode_q8_kernel(
     o_ref: [1, 1, G, D]. K/V dequantize in-register — HBM reads stay
     int8 (+ one f32 scale per slot).
     """
-    _, _, g, d = q_ref.shape
     s = kq_ref.shape[1]
     valid = len_ref[pl.program_id(0)]
-
-    # Dequant is linear: fold the per-slot scales into the [G, S]
-    # scores/probs instead of scaling the [S, D] K/V slabs (D-times
-    # fewer VPU ops; int8 slabs feed the MXU after a bare cast).
-    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
-    scores = jax.lax.dot_general(
-        q,
-        kq_ref[0].astype(jnp.float32),
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * (ks_ref[0] * scale)  # [G, S] * [1, S]
     slot = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
-    scores = jnp.where(slot < valid, scores, _NEG_INF)
-
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    p = jnp.exp(scores - m)
-    p = p / jnp.sum(p, axis=-1, keepdims=True)
-
-    out = jax.lax.dot_general(
-        p * vs_ref[0],  # [G, S] * [1, S]
-        vq_ref[0].astype(jnp.float32),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [G, D]
+    out = _q8_attend(
+        q_ref[0, 0], kq_ref[0], ks_ref[0], vq_ref[0], vs_ref[0],
+        slot < valid, scale,
+    )
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
@@ -236,29 +246,21 @@ def _decode_q8_row_kernel(
     streams Hkv slabs (~0.5 MB) and unrolls the per-head attention; the
     arithmetic is identical (f32 dots), so outputs are bit-equal.
     """
-    hkv, g = q_ref.shape[1], q_ref.shape[2]
+    hkv = q_ref.shape[1]
     s = kq_ref.shape[2]
     valid = len_ref[pl.program_id(0)]
     slot = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
     mask = slot < valid
     for head in range(hkv):  # static unroll over kv heads
-        q = q_ref[0, head].astype(jnp.float32)  # [G, D]
-        scores = jax.lax.dot_general(
-            q,
-            kq_ref[0, head].astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * (ks_ref[0, head][None, :] * scale)  # [G, S]
-        scores = jnp.where(mask, scores, _NEG_INF)
-        m = jnp.max(scores, axis=-1, keepdims=True)
-        p = jnp.exp(scores - m)
-        p = p / jnp.sum(p, axis=-1, keepdims=True)
-        out = jax.lax.dot_general(
-            p * vs_ref[0, head][None, :],
-            vq_ref[0, head].astype(jnp.float32),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [G, D]
+        out = _q8_attend(
+            q_ref[0, head],
+            kq_ref[0, head],
+            ks_ref[0, head][None, :],
+            vq_ref[0, head],
+            vs_ref[0, head][None, :],
+            mask,
+            scale,
+        )
         o_ref[0, head] = out.astype(o_ref.dtype)
 
 
@@ -430,3 +432,117 @@ def flash_decode_attention(
     return (
         out.reshape(b, hkv, 1, g, d).transpose(0, 2, 1, 3, 4).reshape(b, 1, h, d)
     )
+
+
+def flash_decode_attention_q8_stacked(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    layer: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Decode attention reading ONE layer of the stacked int8 cache.
+
+    q: [B, 1, H, D]; k_q/v_q: [L, B, Hkv, S, D] int8 (the WHOLE stacked
+    QuantKVCache buffer); k_scale/v_scale: [L, B, Hkv, S] f32;
+    valid_len: [B]; layer: traced scalar.
+
+    Inside the layer scan a sliced cache layer must be materialized
+    before it can feed ``flash_decode_attention_q8`` (Pallas operands
+    are whole buffers) — XLA copies ~2 x B*Hkv*S*D bytes per layer per
+    step. Here the stack itself is the operand and the layer index rides
+    scalar prefetch into the index_maps, so each row's slab DMAs
+    straight from the resident cache. Same arithmetic as the row
+    program (:func:`_decode_q8_row_kernel`). Falls back to the sliced
+    kernel when the row block exceeds the VMEM budget.
+    """
+    b, _, h, d = q.shape
+    hkv, s = k_q.shape[2], k_q.shape[3]
+    g = h // hkv
+    if interpret is None:
+        interpret = _interpret_default()
+    if 2 * hkv * s * d > _ROW_KERNEL_MAX_KV_BYTES:
+        idx = layer
+        return flash_decode_attention_q8(
+            q,
+            jax.lax.dynamic_index_in_dim(k_q, idx, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(k_scale, idx, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(v_q, idx, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(v_scale, idx, 0, keepdims=False),
+            valid_len,
+            interpret=interpret,
+        )
+    scale = d**-0.5
+
+    q4 = q.reshape(b, 1, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, g, d
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # layer index, per-row valid lengths
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hkv, g, d), lambda i, l, lens: (i, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, hkv, s, d), lambda i, l, lens: (l[0], i, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, hkv, s), lambda i, l, lens: (l[0], i, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, hkv, s, d), lambda i, l, lens: (l[0], i, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, hkv, s), lambda i, l, lens: (l[0], i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hkv, g, d), lambda i, l, lens: (i, 0, 0, 0)
+        ),
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_q8_stacked_kernel, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.atleast_1d(layer).astype(jnp.int32),
+        valid_len.astype(jnp.int32),
+        q4,
+        k_q,
+        k_scale,
+        v_q,
+        v_scale,
+    )
+    return (
+        out.reshape(b, hkv, 1, g, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, 1, h, d)
+    )
+
+
+def _decode_q8_stacked_kernel(
+    l_ref, len_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, o_ref, *,
+    scale: float,
+):
+    """One batch-row program against the stacked cache, all kv heads.
+
+    l_ref: [1] layer (consumed by index_maps); len_ref: [B] valid
+    lengths; q_ref: [1, Hkv, G, D]; kq_ref/vq_ref: [1, 1, Hkv, S, D]
+    int8; ks_ref/vs_ref: [1, 1, Hkv, S] f32; o_ref: [1, Hkv, G, D].
+    Arithmetic is identical to :func:`_decode_q8_row_kernel`.
+    """
+    hkv = q_ref.shape[1]
+    s = kq_ref.shape[3]
+    valid = len_ref[pl.program_id(0)]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
+    mask = slot < valid
+    for head in range(hkv):  # static unroll over kv heads
+        out = _q8_attend(
+            q_ref[0, head],
+            kq_ref[0, 0, head],
+            ks_ref[0, 0, head][None, :],
+            vq_ref[0, 0, head],
+            vs_ref[0, 0, head][None, :],
+            mask,
+            scale,
+        )
+        o_ref[0, head] = out.astype(o_ref.dtype)

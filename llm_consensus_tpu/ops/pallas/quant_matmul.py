@@ -216,3 +216,79 @@ def quant4_matmul_supported(m: int, k: int, n: int) -> bool:
         and 2 * k * 128 <= _MAX_W_TILE_BYTES  # smallest unpacked tile
         and _pick_block(n, target=_blk4_target(k)) is not None
     )
+
+
+# ---------------------------------------------------------------------------
+# Stacked-weight variant: the layer index rides scalar prefetch
+# ---------------------------------------------------------------------------
+
+
+def _qmm_stacked_kernel(l_ref, x_ref, w_ref, s_ref, o_ref):
+    """One N-block program against the [L, K, N] stack.
+
+    l_ref: [1] scalar-prefetch layer index (consumed by the index_maps);
+    x_ref: [M, K] bf16; w_ref: [1, K, blk_n] int8 (this layer's tile);
+    s_ref: [1, 1, blk_n] f32; o_ref: [M, blk_n].
+    """
+    w = w_ref[0].astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        x_ref[...],
+        w,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = (acc * s_ref[0]).astype(o_ref.dtype)
+
+
+def quant_matmul_stacked(
+    x: jnp.ndarray,
+    w_q: jnp.ndarray,
+    scale: jnp.ndarray,
+    layer: jnp.ndarray,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """x [M, K] x int8 w_q[layer] from the stacked [L, K, N] buffer.
+
+    Inside the token-decode layer scan, a sliced per-layer weight must
+    be MATERIALIZED before it can feed ``quant_matmul_2d`` (Pallas
+    operands are whole buffers) — XLA copies every layer's int8 weights
+    every step. Here the STACK is the operand and the traced ``layer``
+    index rides scalar prefetch into the BlockSpec index_maps, so Mosaic
+    DMAs each [K, blk_n] tile straight from the resident stacked buffer:
+    zero copies, same arithmetic as :func:`quant_matmul_2d`.
+    """
+    m, k = x.shape
+    n_layers, k2, n = w_q.shape
+    if k != k2:
+        raise ValueError(f"contraction mismatch {k} vs {k2}")
+    blk_n = _pick_block(n, target=_blk_target(k))
+    if blk_n is None:
+        raise ValueError(
+            f"N={n} (K={k}) has no 128-aligned block within the VMEM budget"
+        )
+    if interpret is None:
+        interpret = _interpret_default()
+    out_dtype = out_dtype or x.dtype
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // blk_n,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda i, l: (0, 0)),
+            pl.BlockSpec((1, k, blk_n), lambda i, l: (l[0], 0, i)),
+            pl.BlockSpec((1, 1, blk_n), lambda i, l: (l[0], 0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, blk_n), lambda i, l: (0, i)),
+    )
+    return pl.pallas_call(
+        _qmm_stacked_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        interpret=interpret,
+    )(
+        jnp.atleast_1d(layer).astype(jnp.int32),
+        x.astype(jnp.bfloat16),
+        w_q,
+        scale.astype(jnp.float32),
+    )

@@ -228,6 +228,59 @@ def _try_kernel_matmul(x, leaf, out_dtype):
     return out.reshape(*lead, n)
 
 
+@dataclass
+class StackedQuant:
+    """Trace-local lazy view of one layer of a stacked quantized weight.
+
+    Built by the layer scan (``models.transformer._run_layers``) instead
+    of slicing the [L, K, N] stack per iteration: a sliced operand to a
+    Pallas kernel must be materialized (XLA copies the whole layer's
+    weights every decode step), but the stacked kernel
+    (:func:`llm_consensus_tpu.ops.pallas.quant_matmul.quant_matmul_stacked`)
+    reads its tiles straight out of the resident stack via a
+    scalar-prefetched layer index. Not a pytree — it never crosses a
+    jit boundary; :func:`matmul` consumes it in-trace.
+    """
+
+    full: QuantizedTensor  # q [L, K, N], scale [L, 1, N]
+    layer: jnp.ndarray  # traced scalar int32
+
+    def sliced(self) -> QuantizedTensor:
+        return QuantizedTensor(
+            q=jax.lax.dynamic_index_in_dim(
+                self.full.q, self.layer, 0, keepdims=False
+            ),
+            scale=jax.lax.dynamic_index_in_dim(
+                self.full.scale, self.layer, 0, keepdims=False
+            ),
+        )
+
+
+def _try_kernel_matmul_stacked(x, leaf: StackedQuant, out_dtype):
+    if not _use_kernel():
+        return None
+    from llm_consensus_tpu.ops.pallas.quant_matmul import (
+        quant_matmul_stacked,
+        quant_matmul_supported,
+    )
+
+    _, k, n = leaf.full.q.shape
+    lead = x.shape[:-1]
+    m = 1
+    for s in lead:
+        m *= s
+    if not quant_matmul_supported(m, k, n):
+        return None
+    out = quant_matmul_stacked(
+        x.reshape(m, k),
+        leaf.full.q,
+        leaf.full.scale,
+        leaf.layer,
+        out_dtype=out_dtype,
+    )
+    return out.reshape(*lead, n)
+
+
 def matmul(x: jnp.ndarray, leaf, out_dtype=None) -> jnp.ndarray:
     """``x [..., K] @ leaf [K, N]`` — quantization-aware.
 
@@ -236,8 +289,14 @@ def matmul(x: jnp.ndarray, leaf, out_dtype=None) -> jnp.ndarray:
     (small M), where XLA's materialize-the-dequant behavior would
     otherwise erase the int8 bandwidth win (see
     ops/pallas/quant_matmul.py); other shapes and sharded runs fall back
-    to dequant + XLA dot.
+    to dequant + XLA dot. ``StackedQuant`` views additionally skip the
+    per-layer slice materialization inside the decode layer scan.
     """
+    if isinstance(leaf, StackedQuant):
+        out = _try_kernel_matmul_stacked(x, leaf, out_dtype)
+        if out is not None:
+            return out
+        leaf = leaf.sliced()  # XLA fuses the slice into the dequant+dot
     if isinstance(leaf, (QuantizedTensor, Quantized4Tensor)):
         out = _try_kernel_matmul(x, leaf, out_dtype)
         if out is not None:

@@ -42,3 +42,21 @@ def cpu_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 simulated devices, got {len(devices)}"
     return devices
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bounded_xla_arena():
+    """Clear JAX compile caches between test modules.
+
+    XLA:CPU keeps every compiled executable alive for the process; an
+    xdist worker that accumulates several heavy modules' programs can
+    hit the process arena limit and abort (the round-2 monolithic-run
+    failure mode, which grows back as the suite grows). Clearing per
+    module bounds each worker at its heaviest single module; cross-
+    module cache hits are rare (different shapes), so the runtime cost
+    is small.
+    """
+    import jax
+
+    jax.clear_caches()
+    yield

@@ -119,3 +119,86 @@ def test_q8_kernel_per_head_fallback_matches_row_kernel(monkeypatch):
     )
     np.testing.assert_allclose(np.asarray(row), np.asarray(ref), atol=2e-2, rtol=2e-2)
     np.testing.assert_allclose(np.asarray(per_head), np.asarray(ref), atol=2e-2, rtol=2e-2)
+
+
+def test_stacked_decode_path_matches_default():
+    """The opt-in stacked-cache decode path must produce the same tokens
+    as the default slice+row-kernel path."""
+    import llm_consensus_tpu.models.transformer as tr
+    from llm_consensus_tpu.engine.generate import generate
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.array([[5, 9, 13, 17, 2, 0, 0, 0]], jnp.int32)
+    lengths = jnp.array([5], jnp.int32)
+
+    def run():
+        return generate(
+            cfg, params, tokens, lengths, jax.random.PRNGKey(1),
+            jnp.zeros(1), max_new_tokens=6, kv_quant=True,
+        )
+
+    base = run()
+    tr.set_stacked_decode(True)
+    try:
+        jax.clear_caches()
+        stacked = run()
+    finally:
+        tr.set_stacked_decode(False)
+        jax.clear_caches()
+    assert base.tokens.tolist() == stacked.tokens.tolist()
+    np.testing.assert_allclose(
+        base.logprob_sum, stacked.logprob_sum, rtol=2e-2, atol=2e-2
+    )
+
+
+def test_q8_stacked_kernel_matches_row_kernel():
+    """The stacked-cache kernel itself (scalar-prefetch index_maps), in
+    interpret mode, against the sliced row kernel across layers and
+    ragged lengths — and the VMEM-budget fallback branch."""
+    import llm_consensus_tpu.ops.pallas.attention as pattn
+    from llm_consensus_tpu.ops.pallas.attention import (
+        flash_decode_attention_q8_stacked,
+    )
+
+    n_layers, b, hkv, g, s, d = 3, 2, 2, 2, 16, 8
+    key = jax.random.PRNGKey(7)
+    q = jax.random.normal(key, (b, 1, hkv * g, d), jnp.float32)
+    k5 = jax.random.normal(
+        jax.random.fold_in(key, 1), (n_layers, b, hkv, s, d)
+    )
+    v5 = jax.random.normal(
+        jax.random.fold_in(key, 2), (n_layers, b, hkv, s, d)
+    )
+    kq5, ks5 = quantize_kv(k5)
+    vq5, vs5 = quantize_kv(v5)
+    valid = jnp.asarray([5, 14], jnp.int32)
+    for layer in range(n_layers):
+        want = flash_decode_attention_q8(
+            q, kq5[layer], ks5[layer], vq5[layer], vs5[layer], valid,
+            interpret=True,
+        )
+        got = flash_decode_attention_q8_stacked(
+            q, kq5, ks5, vq5, vs5, valid, jnp.asarray(layer),
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+    # Budget fallback branch (dynamic-slice + sliced kernel).
+    orig = pattn._ROW_KERNEL_MAX_KV_BYTES
+    pattn._ROW_KERNEL_MAX_KV_BYTES = 0
+    try:
+        fb = flash_decode_attention_q8_stacked(
+            q, kq5, ks5, vq5, vs5, valid, jnp.asarray(1), interpret=True
+        )
+    finally:
+        pattn._ROW_KERNEL_MAX_KV_BYTES = orig
+    want1 = flash_decode_attention_q8(
+        q, kq5[1], ks5[1], vq5[1], vs5[1], valid, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(fb), np.asarray(want1), atol=2e-2, rtol=2e-2
+    )

@@ -293,3 +293,69 @@ def test_int4_params_shard_on_mesh():
     mesh = make_mesh(MeshConfig(data=2, model=2, expert=2))
     sharded = shard_params(qp, mesh)
     assert sharded["blocks"]["wq"].q.sharding.spec == P(None, None, "model")
+
+
+def test_stacked_kernel_matches_per_layer_slice():
+    """quant_matmul_stacked(x, stack, l) == quant_matmul_2d(x, stack[l])."""
+    import numpy as np
+
+    from llm_consensus_tpu.ops.pallas.quant_matmul import (
+        quant_matmul_2d,
+        quant_matmul_stacked,
+    )
+
+    key = jax.random.PRNGKey(0)
+    n_layers, m, k, n = 3, 8, 128, 256
+    w = jax.random.randint(key, (n_layers, k, n), -127, 127, jnp.int8)
+    s = jnp.abs(jax.random.normal(key, (n_layers, 1, n), jnp.float32)) * 0.02
+    x = jax.random.normal(jax.random.fold_in(key, 1), (m, k), jnp.bfloat16)
+    for layer in range(n_layers):
+        want = quant_matmul_2d(x, w[layer], s[layer], interpret=True)
+        got = quant_matmul_stacked(
+            x, w, s, jnp.asarray(layer), interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(want, np.float32),
+            rtol=1e-2,
+            atol=1e-2,
+        )
+
+
+def test_matmul_stacked_quant_view_matches_sliced():
+    """ops.quant.matmul on a StackedQuant view == matmul on the slice,
+    with the kernel both forced on and forced off."""
+    import numpy as np
+
+    from llm_consensus_tpu.ops.quant import (
+        StackedQuant,
+        matmul,
+        quantize_tensor,
+        set_kernel_enabled,
+    )
+
+    key = jax.random.PRNGKey(2)
+    stack = jax.random.normal(key, (2, 128, 256), jnp.float32)
+    qt = quantize_tensor(stack, axis=1)  # [2,128,256] int8, [2,1,256] scale
+    x = jax.random.normal(jax.random.fold_in(key, 3), (4, 128), jnp.bfloat16)
+    from llm_consensus_tpu.ops.quant import QuantizedTensor
+
+    for force in (True, False):
+        set_kernel_enabled(force)
+        try:
+            for layer in range(2):
+                sliced = QuantizedTensor(
+                    q=qt.q[layer], scale=qt.scale[layer]
+                )
+                want = matmul(x, sliced)
+                got = matmul(
+                    x, StackedQuant(full=qt, layer=jnp.asarray(layer))
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got, np.float32),
+                    np.asarray(want, np.float32),
+                    rtol=2e-2,
+                    atol=2e-2,
+                )
+        finally:
+            set_kernel_enabled(None)
