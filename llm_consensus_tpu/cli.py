@@ -189,6 +189,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(needs --backend local)",
     )
     p.add_argument(
+        "--stream",
+        action="store_true",
+        help="stream a single-model completion of --question token by "
+        "token (bypasses the panel protocol; needs --backend local)",
+    )
+    p.add_argument(
         "--eval-gsm8k",
         default=None,
         metavar="JSONL|bundled|synthetic",
@@ -229,6 +235,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_eval(args)
     if args.debate is not None:
         return _run_debate(args)
+    if args.stream:
+        return _run_stream(args)
 
     panel = load_panel(args.panel) if args.panel else default_panel()
     backend = _build_backend(args)
@@ -249,6 +257,25 @@ def main(argv: list[str] | None = None) -> int:
         print(result.answer)
         return 0
     asyncio.run(repl(coord))
+    return 0
+
+
+def _run_stream(args) -> int:
+    if args.backend != "local":
+        print("--stream needs --backend local", file=sys.stderr)
+        return 2
+    if not args.question:
+        print("--stream needs --question", file=sys.stderr)
+        return 2
+    backend = _build_backend(args)
+    for piece in backend.engine.generate_stream(
+        args.question,
+        temperature=args.temperature,
+        seed=args.seed if args.seed is not None else 0,
+        max_new_tokens=args.max_new_tokens,
+    ):
+        print(piece, end="", flush=True)
+    print()
     return 0
 
 

@@ -10,6 +10,7 @@ self-consistency batch is ONE compiled device program: prefill + a
 from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
 from llm_consensus_tpu.engine.generate import (
     GenerateOutput,
+    decode_steps,
     generate,
     generate_from_prefix,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "SamplerConfig",
     "SpecOutput",
     "Tokenizer",
+    "decode_steps",
     "generate",
     "generate_from_prefix",
     "leviathan_accept",

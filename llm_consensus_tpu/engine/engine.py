@@ -36,6 +36,10 @@ _jit_prefill_chunked = jax.jit(
     _prefill_chunked_raw, static_argnames=("cfg", "chunk")
 )
 
+from llm_consensus_tpu.engine.sampler import sample_token as _sample_raw  # noqa: E402
+
+_jit_sample = jax.jit(_sample_raw, static_argnames=("config",))
+
 
 def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
     for b in buckets:
@@ -542,6 +546,158 @@ class InferenceEngine:
             stop_ids=self._stop_ids(stop),
         )
         return self._trim_stops(self._collect(out, n_real), stop)
+
+    def generate_stream(
+        self,
+        prompt: str,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+        max_new_tokens: int | None = None,
+        chunk: int = 16,
+        sampler: SamplerConfig | None = None,
+        stop: list[str] | None = None,
+    ):
+        """Yield text increments for one prompt as tokens decode.
+
+        The streaming surface of the engine: prefill once, then decode
+        in device calls of ``chunk`` steps, yielding the newly decoded
+        text after each (REPL/interactive serving — the reference's UX
+        blocks on the whole remote answer, ``src/main.rs:448-463``).
+        Greedy streaming concatenates to exactly ``generate_texts``'s
+        output; sampled streams draw per-chunk PRNG subkeys. Stop
+        sequences are honored across chunk boundaries. Sharded engines
+        fall back to one non-incremental yield.
+        """
+        if self.mesh is not None:
+            r = self.generate_texts(
+                [prompt],
+                temperatures=[temperature],
+                seed=seed,
+                max_new_tokens=max_new_tokens,
+                sampler=sampler,
+                stop=stop,
+            )[0]
+            if r.text:
+                yield r.text
+            return
+        from llm_consensus_tpu.engine.generate import decode_steps
+        from llm_consensus_tpu.models.cache import KVCache, QuantKVCache
+
+        tok_ = self.tokenizer
+        tokens, lengths, _ = self._prepare([prompt])
+        s = tokens.shape[1]
+        mnt = max_new_tokens or self.config.max_new_tokens
+        mnt = max(1, min(mnt, self.cfg.max_seq_len - s))
+        chunk = max(1, chunk)
+        sampler_cfg = sampler if sampler is not None else self.config.sampler
+        stop = stop or []
+        stop_ids = self._stop_ids(stop)
+        terminal = {tok_.eos_id, *stop_ids}
+
+        make_cache = (
+            QuantKVCache.create if self.config.kv_quant else KVCache.create
+        )
+        cache = make_cache(self.cfg, 1, s + mnt)
+        # _prepare pads to the batch bucket; the stream decodes one row.
+        tokens_j = jnp.asarray(tokens[:1])
+        lengths_j = jnp.asarray(lengths[:1])
+        if (
+            self.config.prefill_chunk
+            and s > self.config.prefill_chunk
+            and not self.config.kv_quant
+        ):
+            logits, cache = _jit_prefill_chunked(
+                self.cfg, self.params, tokens_j, lengths_j, cache,
+                chunk=self.config.prefill_chunk,
+            )
+        else:
+            logits, cache = _jit_prefill(
+                self.cfg, self.params, tokens_j, lengths_j, cache
+            )
+        key = jax.random.PRNGKey(seed)
+        temps = jnp.asarray([temperature], jnp.float32)
+        tok, _ = _jit_sample(
+            logits, jax.random.fold_in(key, 0), temps, sampler_cfg
+        )
+        first = int(tok[0])
+        ids: list[int] = [] if first in terminal else [first]
+        done = jnp.asarray([first in terminal])
+        yielded = 0
+
+        def _flush(final: bool):
+            """(increment, finished): emit decoded text past what was
+            already yielded, holding back (a) any tail that is a partial
+            match of a stop string (it may complete next chunk and must
+            then be trimmed, never emitted) and (b) trailing replacement
+            chars from split multi-byte sequences."""
+            nonlocal yielded
+            t = tok_.decode(ids)
+            cut = min((i for x in stop if (i := t.find(x)) >= 0), default=-1)
+            finished = cut >= 0
+            if finished:
+                t = t[:cut]
+            emit_to = len(t)
+            if not finished and not final:
+                hold = 0
+                for x in stop:
+                    for k in range(min(len(x) - 1, len(t)), 0, -1):
+                        if t.endswith(x[:k]):
+                            hold = max(hold, k)
+                            break
+                emit_to = len(t) - hold
+                while emit_to > yielded and t[emit_to - 1] == "�":
+                    emit_to -= 1
+            inc = t[yielded:emit_to]
+            yielded = max(yielded, emit_to)
+            return inc, finished
+
+        inc, finished = _flush(final=False)
+        if inc:
+            yield inc
+        if finished:
+            return
+        produced = 1
+        chunk_i = 0
+        while produced < mnt and not bool(done[0]):
+            # Always run a full `chunk` of steps — `steps` is a static
+            # jit arg, so a shorter tail would compile a second decode
+            # program mid-stream. Overshoot tokens past the budget are
+            # discarded (their cache writes past capacity are dropped
+            # by scatter OOB semantics, and the loop ends this chunk).
+            k = min(chunk, mnt - produced)
+            chunk_i += 1
+            out, live, cache, done, tok, _ = decode_steps(
+                self.cfg,
+                self.params,
+                cache,
+                tok,
+                done,
+                jax.random.fold_in(key, chunk_i),
+                temps,
+                steps=chunk,
+                sampler=sampler_cfg,
+                eos_id=tok_.eos_id,
+                pad_id=tok_.pad_id,
+                stop_ids=stop_ids,
+            )
+            produced += k
+            # A genuinely sampled pad id while live stays in the text
+            # (matching generate_texts); only post-termination padding
+            # and terminal tokens (eos / device stops) are dropped.
+            ids.extend(
+                t
+                for t, alive in zip(out[0, :k].tolist(), live[0, :k].tolist())
+                if alive and t not in terminal
+            )
+            inc, finished = _flush(final=False)
+            if inc:
+                yield inc
+            if finished:
+                return
+        inc, _ = _flush(final=True)
+        if inc:
+            yield inc
 
     def generate_texts_speculative(
         self,

@@ -159,6 +159,20 @@ def generate(
     )
 
 
+def _terminal_matcher(eos_id: int, stop_ids: tuple[int, ...]):
+    """Token-level termination predicate shared by the batch decode loop
+    and the streaming chunk loop — the semantics live in one place."""
+    terminal = (eos_id,) + tuple(stop_ids)
+
+    def _is_terminal(tok):
+        hit = tok == terminal[0]
+        for t in terminal[1:]:
+            hit = hit | (tok == t)
+        return hit
+
+    return _is_terminal
+
+
 def _decode_loop(
     cfg: ModelConfig,
     params: dict,
@@ -183,13 +197,7 @@ def _decode_loop(
     logprob accumulation and the host can trim deterministically.
     """
     b = logits.shape[0]
-    terminal = (eos_id,) + tuple(stop_ids)
-
-    def _is_terminal(tok):
-        hit = tok == terminal[0]
-        for t in terminal[1:]:
-            hit = hit | (tok == t)
-        return hit
+    _is_terminal = _terminal_matcher(eos_id, stop_ids)
 
     key0 = jax.random.fold_in(key, 0)
     tok0, lp0 = sample_token(logits, key0, temperature, sampler)
@@ -354,3 +362,60 @@ def generate_from_prefix(
         uniform_write=shared_suffix,
         stop_ids=stop_ids,
     )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "sampler", "eos_id", "pad_id", "stop_ids"),
+    donate_argnames=("cache",),
+)
+def decode_steps(
+    cfg: ModelConfig,
+    params: dict,
+    cache,
+    tok: jnp.ndarray,
+    done: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    *,
+    steps: int,
+    sampler: SamplerConfig = SamplerConfig(),
+    eos_id: int = 2,
+    pad_id: int = 0,
+    stop_ids: tuple[int, ...] = (),
+):
+    """Run ``steps`` decode iterations from an existing cache (streaming).
+
+    The incremental sibling of :func:`generate`'s scan: the caller holds
+    the cache across calls and consumes tokens chunk by chunk (REPL
+    streaming, interactive serving). ``tok`` [B] is the last sampled
+    token (already written? NO — not yet attended; it is fed as this
+    chunk's first input), ``done`` [B] the rows already terminated.
+    The cache argument is DONATED — the caller must replace its handle
+    with the returned cache.
+
+    Returns (tokens [B, steps] — pad after termination, live [B, steps]
+    — True where the row was still generating when the slot was emitted
+    (distinguishes post-termination padding from a genuinely sampled
+    pad id), new_cache, new_done, new_tok, logprob_sum [B] for the
+    chunk).
+    """
+    _is_terminal = _terminal_matcher(eos_id, stop_ids)
+
+    def step(carry, i):
+        tok, cache, done, lp = carry
+        logits, cache = decode_step(cfg, params, tok[:, None], cache)
+        step_key = jax.random.fold_in(key, i)
+        nxt, lp_i = sample_token(logits, step_key, temperature, sampler)
+        nxt = jnp.where(done, pad_id, nxt)
+        lp = lp + jnp.where(done, 0.0, lp_i)
+        next_done = done | _is_terminal(nxt)
+        return (nxt, cache, next_done, lp), (nxt, done)
+
+    b = tok.shape[0]
+    lp0 = jnp.zeros((b,), jnp.float32)
+    (tok_n, cache, done_n, lp), (toks, dones) = jax.lax.scan(
+        step, (tok, cache, done, lp0), jnp.arange(steps)
+    )
+    out = jnp.where(dones.T, pad_id, toks.T)  # [B, steps]
+    return out, ~dones.T, cache, done_n, tok_n, lp

@@ -173,3 +173,28 @@ def test_debate_rejects_bad_n(capsys):
         "--question", "q", "--debate", "-1",
     ])
     assert rc == 2
+
+
+def test_cli_stream_prints_completion(capsys):
+    """--stream emits a single-model streamed completion."""
+    from llm_consensus_tpu.cli import main
+
+    rc = main(
+        [
+            "--backend", "local",
+            "--model", "test-tiny",
+            "--question", "hello there",
+            "--stream",
+            "--max-new-tokens", "6",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.endswith("\n")
+
+
+def test_cli_stream_requires_local_backend():
+    from llm_consensus_tpu.cli import main
+
+    assert main(["--stream", "--question", "q"]) == 2
+    assert main(["--backend", "local", "--model", "test-tiny", "--stream"]) == 2
