@@ -22,6 +22,7 @@ from llm_consensus_tpu.consensus.debate import (
 from llm_consensus_tpu.consensus.voting import (
     VoteResult,
     logit_pool,
+    rescore_vote,
     majority_vote,
     self_consistency,
     weighted_vote,
@@ -41,6 +42,7 @@ __all__ = [
     "default_panel",
     "load_panel",
     "logit_pool",
+    "rescore_vote",
     "majority_vote",
     "run_debate",
     "save_panel",

@@ -127,6 +127,27 @@ def logit_pool(
     return _vote(answers, list(w / w.sum()), key_fn)
 
 
+def rescore_vote(
+    engine,
+    prompt: str,
+    answers: list[str],
+    key_fn=canonicalize,
+    normalize: bool = True,
+) -> VoteResult:
+    """Logit-pool candidates under a JUDGE model's own scores.
+
+    The candidates can come from anywhere — other panel models, debate
+    rounds, humans; ``engine.score_texts`` (teacher-forced, one chunk
+    forward) assigns each its log-probability given ``prompt``, and the
+    pool weights by that mass. This is cross-model reranking: the
+    generalization of logit pooling to candidates the judge did not
+    sample itself. ``normalize`` length-normalizes so verbose answers
+    aren't penalized linearly.
+    """
+    scores = engine.score_texts(prompt, answers, normalize=normalize)
+    return logit_pool(answers, scores, key_fn)
+
+
 # ---------------------------------------------------------------------------
 # On-device reducer (north-star: all-gather/psum + argmax over candidates)
 # ---------------------------------------------------------------------------

@@ -13,6 +13,7 @@ from llm_consensus_tpu.engine.generate import (
     decode_steps,
     generate,
     generate_from_prefix,
+    score_completions,
 )
 from llm_consensus_tpu.engine.prefix_cache import PrefixCache
 from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
@@ -39,6 +40,7 @@ __all__ = [
     "decode_steps",
     "generate",
     "generate_from_prefix",
+    "score_completions",
     "leviathan_accept",
     "load_tokenizer",
     "sample_token",

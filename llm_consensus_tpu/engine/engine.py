@@ -699,6 +699,87 @@ class InferenceEngine:
         if inc:
             yield inc
 
+    def score_texts(
+        self,
+        prompt: str,
+        completions: list[str],
+        *,
+        normalize: bool = False,
+    ) -> list[float]:
+        """Log-probability of each completion given ``prompt``.
+
+        Teacher-forced scoring — no sampling: the prompt prefills once,
+        its cache broadcasts, and every completion's tokens score in one
+        ragged chunk forward. ``normalize``: divide by token count
+        (length-normalized, for comparing completions of different
+        lengths). Candidates can come from anywhere — another model of
+        a heterogeneous panel, a debate round, a human draft — making
+        this the reranking/logit-pooling half of answer aggregation.
+        bf16 cache, single-device/data-replicated params.
+        """
+        if not completions:
+            return []
+        if self.mesh is not None:
+            raise ValueError("score_texts is single-device (no mesh path)")
+        # Batches beyond the largest bucket score in chunks.
+        max_b = self.config.batch_buckets[-1]
+        if len(completions) > max_b:
+            out: list[float] = []
+            for i in range(0, len(completions), max_b):
+                out.extend(
+                    self.score_texts(
+                        prompt,
+                        completions[i : i + max_b],
+                        normalize=normalize,
+                    )
+                )
+            return out
+        from llm_consensus_tpu.engine.generate import score_completions
+
+        tok = self.tokenizer
+        ctx = self.cfg.max_seq_len
+        p_ids = tok.encode(prompt)[-(ctx - 2) :]
+        p = len(p_ids)
+        # Prompt pads to a seq bucket (the true length rides as data) so
+        # repeat calls with different prompt lengths share one compiled
+        # program — the engine-wide bucketing contract.
+        sp = max(p, min(_next_bucket(p, self.config.seq_buckets), ctx - 1))
+        comp_cap = min(ctx - p, self.config.seq_buckets[-1])
+        comp = [
+            tok.encode(c, add_bos=False)[:comp_cap] for c in completions
+        ]
+        if any(len(c) < 1 for c in comp):
+            raise ValueError("cannot score an empty completion")
+        k = min(
+            _next_bucket(max(len(c) for c in comp), self.config.seq_buckets),
+            comp_cap,
+        )
+        k = max(k, max(len(c) for c in comp))
+        b = _next_bucket(len(comp), self.config.batch_buckets)
+        ctoks = np.full((b, k), tok.pad_id, np.int32)
+        for i, ids in enumerate(comp):
+            ctoks[i, : len(ids)] = ids
+        clens = np.ones((b,), np.int32)
+        clens[: len(comp)] = [len(c) for c in comp]
+        ptoks = np.full((1, sp), tok.pad_id, np.int32)
+        ptoks[0, :p] = p_ids
+        with self._span(
+            "engine.score", batch=b, prompt=p, k=k, n_real=len(comp)
+        ):
+            sums, _ = score_completions(
+                self.cfg,
+                self.params,
+                jnp.asarray(ptoks),
+                jnp.asarray([p], jnp.int32),
+                jnp.asarray(ctoks),
+                jnp.asarray(clens),
+                cache_len=sp + k,
+            )
+        out = np.asarray(sums)[: len(comp)].tolist()
+        if normalize:
+            out = [s / max(len(c), 1) for s, c in zip(out, comp)]
+        return out
+
     def generate_texts_speculative(
         self,
         prompts: list[str],

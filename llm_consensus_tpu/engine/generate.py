@@ -419,3 +419,58 @@ def decode_steps(
     )
     out = jnp.where(dones.T, pad_id, toks.T)  # [B, steps]
     return out, ~dones.T, cache, done_n, tok_n, lp
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "cache_len"),
+)
+def score_completions(
+    cfg: ModelConfig,
+    params: dict,
+    prompt_tokens: jnp.ndarray,
+    prompt_len: jnp.ndarray,
+    comp_tokens: jnp.ndarray,
+    comp_lens: jnp.ndarray,
+    *,
+    cache_len: int,
+):
+    """Teacher-forced log-probability of completions under the model.
+
+    prompt_tokens: [1, S] right-padded shared prompt; prompt_len: [1];
+    comp_tokens: [B, K] right-padded completions; comp_lens: [B].
+    The prompt prefills ONCE at B=1, its cache broadcasts to the B
+    completions, and all K completion positions score in one ragged
+    chunk forward (:func:`~llm_consensus_tpu.models.transformer.decode_chunk`
+    semantics) — no sampling, no decode loop. Returns (logprob_sum [B],
+    per-token logprobs [B, K] — zero past each completion's length).
+
+    The scoring half of candidate aggregation: logit pooling / weighted
+    reranking over candidates that were produced elsewhere (another
+    model of a heterogeneous panel, a debate round, a human draft).
+    """
+    from llm_consensus_tpu.models.transformer import decode_chunk
+
+    b, k = comp_tokens.shape
+
+    cache1 = KVCache.create(cfg, 1, cache_len)
+    logits1, cache1 = prefill(cfg, params, prompt_tokens, prompt_len, cache1)
+    cache = _broadcast_cache(cache1, b)
+
+    chunk_logits, _ = decode_chunk(cfg, params, comp_tokens, cache)
+    # Position i of the chunk predicts token i+1; the prompt's last
+    # logits predict token 0.
+    all_logits = jnp.concatenate(
+        [
+            jnp.broadcast_to(logits1, (b, logits1.shape[-1]))[:, None],
+            chunk_logits[:, :-1].astype(jnp.float32),
+        ],
+        axis=1,
+    )  # [B, K, V]
+    lps = jax.nn.log_softmax(all_logits, axis=-1)
+    tok_lp = jnp.take_along_axis(
+        lps, comp_tokens[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]  # [B, K]
+    mask = jnp.arange(k)[None, :] < comp_lens[:, None]
+    tok_lp = jnp.where(mask, tok_lp, 0.0)
+    return tok_lp.sum(axis=1), tok_lp

@@ -248,3 +248,28 @@ def test_device_vote_tie_breaks_like_host_vote():
     host = majority_vote(texts)
     dev = _device_vote(eng, texts, canonicalize)
     assert dev.winner == host.winner == "banana"
+
+
+def test_rescore_vote_pools_by_judge_scores():
+    """rescore_vote == logit_pool under the judge engine's own scores."""
+    import jax
+
+    from llm_consensus_tpu.consensus.voting import logit_pool, rescore_vote
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(8, 16, 32), batch_buckets=(1, 2, 4)
+        ),
+    )
+    answers = ["#### 4", "#### 5", "#### 4 indeed"]
+    got = rescore_vote(eng, "Q: 2+2?", answers)
+    scores = eng.score_texts("Q: 2+2?", answers, normalize=True)
+    want = logit_pool(answers, scores)
+    assert got.winner == want.winner
+    assert got.tally == want.tally
