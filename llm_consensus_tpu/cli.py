@@ -189,6 +189,13 @@ def build_parser() -> argparse.ArgumentParser:
         "(needs --backend local)",
     )
     p.add_argument(
+        "--debate-method",
+        default="majority",
+        choices=("majority", "logit_pool", "rescore"),
+        help="per-round debate vote: head count, pool by sampling "
+        "logprob, or teacher-forced judge re-scoring",
+    )
+    p.add_argument(
         "--stream",
         action="store_true",
         help="stream a single-model completion of --question token by "
@@ -301,6 +308,7 @@ def _run_debate(args) -> int:
             temperature=args.temperature,
             max_new_tokens=args.max_new_tokens,
             seed=args.seed or 0,
+            method=args.debate_method,
         ),
     )
     log.info(

@@ -25,7 +25,9 @@ from dataclasses import dataclass, field
 from llm_consensus_tpu.consensus.voting import (
     VoteResult,
     canonicalize,
+    logit_pool,
     majority_vote,
+    rescore_vote,
 )
 
 
@@ -41,6 +43,11 @@ class DebateConfig:
     # keeps prompts bounded at large N).
     peer_sample: int = 4
     seed: int = 0
+    # Per-round vote: "majority" (count), "logit_pool" (pool by each
+    # candidate's own sampling logprob), or "rescore" (teacher-forced
+    # re-scoring of every answer under the engine — judge-model
+    # reranking; needs ``engine.score_texts``).
+    method: str = "majority"
 
 
 @dataclass
@@ -88,6 +95,15 @@ def run_debate(
     batched call — N is the data-parallel candidate axis on the mesh.
     """
     cfg = config or DebateConfig()
+    # Fail before any generation: a typo'd method or an incompatible
+    # engine must not burn an N-candidate TPU round first.
+    if cfg.method not in ("majority", "logit_pool", "rescore"):
+        raise ValueError(f"unknown debate vote method {cfg.method!r}")
+    if cfg.method == "rescore" and getattr(engine, "mesh", None) is not None:
+        raise ValueError(
+            "method='rescore' needs score_texts, which has no mesh path — "
+            "use a single-device judge engine or another method"
+        )
     n = cfg.n_candidates
     rounds: list[DebateRound] = []
     total_tokens = 0
@@ -103,9 +119,23 @@ def run_debate(
         )
         answers = [res.text for res in results]
         total_tokens += sum(res.num_tokens for res in results)
-        vote = majority_vote(answers, key_fn)
+        if cfg.method == "majority":
+            vote = majority_vote(answers, key_fn)
+        elif cfg.method == "logit_pool":
+            vote = logit_pool(
+                answers, [res.logprob for res in results], key_fn
+            )
+        else:  # "rescore" (validated above)
+            vote = rescore_vote(
+                engine, _INITIAL.format(q=question), answers, key_fn
+            )
         rounds.append(DebateRound(answers=answers, vote=vote))
-        lead = max(vote.tally.values()) / max(sum(vote.tally.values()), 1e-9)
+        # The quorum early-exit always measures HEADCOUNT agreement:
+        # pooled probability mass (logit_pool/rescore) is near-one-hot
+        # whenever sequence logprobs differ by a few nats, which would
+        # end every debate after round 1 regardless of actual consensus.
+        heads = majority_vote(answers, key_fn)
+        lead = max(heads.tally.values()) / max(sum(heads.tally.values()), 1e-9)
         if lead >= cfg.quorum:
             break
         if r + 1 < cfg.max_rounds:

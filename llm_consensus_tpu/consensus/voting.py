@@ -144,7 +144,16 @@ def rescore_vote(
     sample itself. ``normalize`` length-normalizes so verbose answers
     aren't penalized linearly.
     """
-    scores = engine.score_texts(prompt, answers, normalize=normalize)
+    nonempty = [a for a in answers if a]
+    scored = (
+        engine.score_texts(prompt, nonempty, normalize=normalize)
+        if nonempty
+        else []
+    )
+    it = iter(scored)
+    # Empty answers (a candidate that emitted EOS immediately) cannot be
+    # teacher-forced; they pool with ~zero mass instead of erroring.
+    scores = [next(it) if a else -1e30 for a in answers]
     return logit_pool(answers, scores, key_fn)
 
 

@@ -151,6 +151,9 @@ class InferenceEngine:
             max_entries=self.config.prefix_cache_entries,
             max_bytes=self.config.prefix_cache_bytes,
         )
+        # Lifetime counters; see stats().
+        self._calls = {"generate": 0, "speculative": 0, "stream": 0, "score": 0}
+        self._tokens_generated = 0
         self.mesh = mesh
         self._data_sharding = None
         if mesh is not None:
@@ -443,6 +446,7 @@ class InferenceEngine:
         # Identical suffixes (self-consistency fan-out under a cached
         # header): chunk the suffix once at B=1 and broadcast.
         shared = n_real == b and len(set(prompts)) == 1 and b > 1
+        self._calls["generate"] += 1
         with self._span(
             "engine.generate_prefix",
             batch=b,
@@ -469,10 +473,31 @@ class InferenceEngine:
             )
         return self._trim_stops(self._collect(out, n_real), stop)
 
+    def stats(self) -> dict:
+        """Lifetime engine counters (observability surface).
+
+        Calls per API, total generated tokens, and the prefix cache's
+        hit/miss/eviction counts + resident bytes — the numbers a
+        serving dashboard or an eval report wants without tracing.
+        """
+        pc = self.prefix_cache
+        return {
+            "calls": dict(self._calls),
+            "tokens_generated": self._tokens_generated,
+            "prefix_cache": {
+                "hits": pc.stats.hits,
+                "misses": pc.stats.misses,
+                "evictions": pc.stats.evictions,
+                "entries": len(pc),
+                "bytes": pc.nbytes,
+            },
+        }
+
     def _collect(self, out: GenerateOutput, n_real: int) -> list[EngineResult]:
         toks = np.asarray(out.tokens)
         nums = np.asarray(out.num_tokens)
         lps = np.asarray(out.logprob_sum)
+        self._tokens_generated += int(nums[:n_real].sum())
         results = []
         for i in range(n_real):
             n = int(nums[i])
@@ -506,6 +531,7 @@ class InferenceEngine:
         sampler,
         stop=None,
     ) -> list[EngineResult]:
+        self._calls["generate"] += 1
         b = tokens.shape[0]
         temps = np.zeros((b,), np.float32)
         if temperatures is not None:
@@ -584,6 +610,7 @@ class InferenceEngine:
         from llm_consensus_tpu.engine.generate import decode_steps
         from llm_consensus_tpu.models.cache import KVCache, QuantKVCache
 
+        self._calls["stream"] += 1
         tok_ = self.tokenizer
         tokens, lengths, _ = self._prepare([prompt])
         s = tokens.shape[1]
@@ -623,6 +650,7 @@ class InferenceEngine:
         first = int(tok[0])
         ids: list[int] = [] if first in terminal else [first]
         done = jnp.asarray([first in terminal])
+        self._tokens_generated += 1
         yielded = 0
 
         def _flush(final: bool):
@@ -682,6 +710,7 @@ class InferenceEngine:
                 stop_ids=stop_ids,
             )
             produced += k
+            self._tokens_generated += int(np.asarray(live[0, :k]).sum())
             # A genuinely sampled pad id while live stays in the text
             # (matching generate_texts); only post-termination padding
             # and terminal tokens (eos / device stops) are dropped.
@@ -736,6 +765,7 @@ class InferenceEngine:
             return out
         from llm_consensus_tpu.engine.generate import score_completions
 
+        self._calls["score"] += 1
         tok = self.tokenizer
         ctx = self.cfg.max_seq_len
         p_ids = tok.encode(prompt)[-(ctx - 2) :]
@@ -812,6 +842,7 @@ class InferenceEngine:
             return out
         from llm_consensus_tpu.engine.speculative import speculative_generate
 
+        self._calls["speculative"] += 1
         draft_cfg, draft_params = self.draft
         tokens, lengths, n_real = self._prepare(prompts)
         # Same clamp as generate_texts — the k_spec+1 chunk slack lives

@@ -87,3 +87,94 @@ def test_debate_on_real_tiny_engine():
     assert 1 <= res.n_rounds <= 2
     assert isinstance(res.answer, str)
     assert res.total_tokens >= 4
+
+
+def test_debate_vote_methods():
+    """logit_pool and rescore vote methods run end to end; unknown
+    methods are rejected."""
+    import jax
+    import pytest
+
+    from llm_consensus_tpu.consensus.debate import DebateConfig, run_debate
+    from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    cfg = get_config("test-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=6, seq_buckets=(64, 128, 256),
+            batch_buckets=(1, 2, 4),
+        ),
+    )
+    for method in ("logit_pool", "rescore"):
+        res = run_debate(
+            eng, "What is 2+2?",
+            DebateConfig(
+                n_candidates=2, max_rounds=1, max_new_tokens=6,
+                method=method,
+            ),
+        )
+        assert res.n_rounds == 1
+        assert isinstance(res.answer, str)
+    with pytest.raises(ValueError, match="unknown debate vote method"):
+        run_debate(
+            eng, "q",
+            DebateConfig(n_candidates=2, max_rounds=1, method="nope"),
+        )
+
+
+def test_debate_quorum_uses_headcount_not_pooled_mass():
+    """With logit_pool voting, a split panel must still run revision
+    rounds — the early exit measures headcount, not pooled mass."""
+    from llm_consensus_tpu.consensus.debate import DebateConfig, run_debate
+    from llm_consensus_tpu.engine.engine import EngineResult
+
+    class SplitEngine:
+        """Half the panel answers 4, half answers 5, with very different
+        logprobs (pooled mass would be one-hot)."""
+
+        def __init__(self):
+            self.calls = 0
+
+        def generate_texts(self, prompts, temperatures=None, seed=0,
+                           max_new_tokens=None, sampler=None):
+            self.calls += 1
+            out = []
+            for i in range(len(prompts)):
+                ans = "#### 4" if i % 2 == 0 else "#### 5"
+                lp = -1.0 if i % 2 == 0 else -20.0
+                out.append(EngineResult(
+                    text=ans, num_tokens=3, logprob=lp, token_ids=[1, 2, 3]
+                ))
+            return out
+
+    eng = SplitEngine()
+    res = run_debate(
+        eng, "2+2?",
+        DebateConfig(n_candidates=4, max_rounds=3, method="logit_pool",
+                     quorum=0.75),
+    )
+    assert eng.calls == 3  # 50/50 headcount never reaches quorum
+    assert res.n_rounds == 3
+
+
+def test_debate_validates_before_generating():
+    from llm_consensus_tpu.consensus.debate import DebateConfig, run_debate
+
+    class ExplodingEngine:
+        mesh = None
+
+        def generate_texts(self, *a, **k):
+            raise AssertionError("must not generate")
+
+    with pytest.raises(ValueError, match="unknown debate vote method"):
+        run_debate(ExplodingEngine(), "q", DebateConfig(method="typo"))
+
+    class MeshEngine(ExplodingEngine):
+        mesh = object()
+
+    with pytest.raises(ValueError, match="no mesh path"):
+        run_debate(MeshEngine(), "q", DebateConfig(method="rescore"))

@@ -770,3 +770,25 @@ def test_score_texts_chunks_and_truncates(tiny):
     # Different prompt length, same buckets: must not error and should
     # reuse the compiled program (behavioral check only).
     assert len(eng.score_texts("p2:!", [" a"])) == 1
+
+
+def test_engine_stats_counters(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=6, seq_buckets=(16, 32), batch_buckets=(1, 2)
+        ),
+    )
+    assert eng.stats()["calls"]["generate"] == 0
+    eng.generate_texts(["hello"])
+    "".join(eng.generate_stream("hi", chunk=2))
+    eng.score_texts("p:", [" x"])
+    s = eng.stats()
+    assert s["calls"] == {
+        "generate": 1, "speculative": 0, "stream": 1, "score": 1
+    }
+    assert s["tokens_generated"] >= 2
+    assert set(s["prefix_cache"]) == {
+        "hits", "misses", "evictions", "entries", "bytes"
+    }
