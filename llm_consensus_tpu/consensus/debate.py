@@ -99,10 +99,13 @@ def run_debate(
     # engine must not burn an N-candidate TPU round first.
     if cfg.method not in ("majority", "logit_pool", "rescore"):
         raise ValueError(f"unknown debate vote method {cfg.method!r}")
-    if cfg.method == "rescore" and getattr(engine, "mesh", None) is not None:
+    if cfg.method == "rescore" and (
+        not hasattr(engine, "score_texts")
+        or getattr(engine, "mesh", None) is not None
+    ):
         raise ValueError(
-            "method='rescore' needs score_texts, which has no mesh path — "
-            "use a single-device judge engine or another method"
+            "method='rescore' needs an engine with score_texts and no "
+            "mesh — use a single-device judge engine or another method"
         )
     n = cfg.n_candidates
     rounds: list[DebateRound] = []
@@ -134,7 +137,9 @@ def run_debate(
         # pooled probability mass (logit_pool/rescore) is near-one-hot
         # whenever sequence logprobs differ by a few nats, which would
         # end every debate after round 1 regardless of actual consensus.
-        heads = majority_vote(answers, key_fn)
+        heads = (
+            vote if cfg.method == "majority" else majority_vote(answers, key_fn)
+        )
         lead = max(heads.tally.values()) / max(sum(heads.tally.values()), 1e-9)
         if lead >= cfg.quorum:
             break

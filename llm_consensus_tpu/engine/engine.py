@@ -235,6 +235,7 @@ class InferenceEngine:
         sampler: SamplerConfig | None = None,
         prefix: str | None = None,
         stop: list[str] | None = None,
+        _outer: bool = True,
     ) -> list[EngineResult]:
         """Generate one completion per prompt.
 
@@ -258,6 +259,8 @@ class InferenceEngine:
         """
         if not prompts:
             return []
+        if _outer:
+            self._calls["generate"] += 1
         chunk = self.config.batch_buckets[-1]
         if len(prompts) > chunk:
             out: list[EngineResult] = []
@@ -276,6 +279,7 @@ class InferenceEngine:
                         sampler=sampler,
                         prefix=prefix,
                         stop=stop,
+                        _outer=False,
                     )
                 )
             return out
@@ -397,6 +401,7 @@ class InferenceEngine:
                 max_new_tokens=max_new_tokens,
                 sampler=sampler,
                 stop=stop,
+                _outer=False,
             )
 
         native = self._native_encode(prompts, ctx, add_bos=False)
@@ -446,7 +451,6 @@ class InferenceEngine:
         # Identical suffixes (self-consistency fan-out under a cached
         # header): chunk the suffix once at B=1 and broadcast.
         shared = n_real == b and len(set(prompts)) == 1 and b > 1
-        self._calls["generate"] += 1
         with self._span(
             "engine.generate_prefix",
             batch=b,
@@ -531,7 +535,6 @@ class InferenceEngine:
         sampler,
         stop=None,
     ) -> list[EngineResult]:
-        self._calls["generate"] += 1
         b = tokens.shape[0]
         temps = np.zeros((b,), np.float32)
         if temperatures is not None:
@@ -595,6 +598,7 @@ class InferenceEngine:
         sequences are honored across chunk boundaries. Sharded engines
         fall back to one non-incremental yield.
         """
+        self._calls["stream"] += 1
         if self.mesh is not None:
             r = self.generate_texts(
                 [prompt],
@@ -603,6 +607,7 @@ class InferenceEngine:
                 max_new_tokens=max_new_tokens,
                 sampler=sampler,
                 stop=stop,
+                _outer=False,
             )[0]
             if r.text:
                 yield r.text
@@ -610,7 +615,6 @@ class InferenceEngine:
         from llm_consensus_tpu.engine.generate import decode_steps
         from llm_consensus_tpu.models.cache import KVCache, QuantKVCache
 
-        self._calls["stream"] += 1
         tok_ = self.tokenizer
         tokens, lengths, _ = self._prepare([prompt])
         s = tokens.shape[1]
@@ -734,6 +738,7 @@ class InferenceEngine:
         completions: list[str],
         *,
         normalize: bool = False,
+        _outer: bool = True,
     ) -> list[float]:
         """Log-probability of each completion given ``prompt``.
 
@@ -750,6 +755,8 @@ class InferenceEngine:
             return []
         if self.mesh is not None:
             raise ValueError("score_texts is single-device (no mesh path)")
+        if _outer:
+            self._calls["score"] += 1
         # Batches beyond the largest bucket score in chunks.
         max_b = self.config.batch_buckets[-1]
         if len(completions) > max_b:
@@ -760,12 +767,12 @@ class InferenceEngine:
                         prompt,
                         completions[i : i + max_b],
                         normalize=normalize,
+                        _outer=False,
                     )
                 )
             return out
         from llm_consensus_tpu.engine.generate import score_completions
 
-        self._calls["score"] += 1
         tok = self.tokenizer
         ctx = self.cfg.max_seq_len
         p_ids = tok.encode(prompt)[-(ctx - 2) :]
@@ -815,6 +822,7 @@ class InferenceEngine:
         prompts: list[str],
         max_new_tokens: int | None = None,
         k_spec: int = 4,
+        _outer: bool = True,
     ) -> list[EngineResult]:
         """Greedy generation accelerated by the draft model.
 
@@ -828,6 +836,8 @@ class InferenceEngine:
             raise ValueError("engine was built without a draft model")
         if not prompts:
             return []
+        if _outer:
+            self._calls["speculative"] += 1
         chunk = self.config.batch_buckets[-1]
         if len(prompts) > chunk:
             out: list[EngineResult] = []
@@ -837,12 +847,12 @@ class InferenceEngine:
                         prompts[i : i + chunk],
                         max_new_tokens=max_new_tokens,
                         k_spec=k_spec,
+                        _outer=False,
                     )
                 )
             return out
         from llm_consensus_tpu.engine.speculative import speculative_generate
 
-        self._calls["speculative"] += 1
         draft_cfg, draft_params = self.draft
         tokens, lengths, n_real = self._prepare(prompts)
         # Same clamp as generate_texts — the k_spec+1 chunk slack lives

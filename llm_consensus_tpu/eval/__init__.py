@@ -11,6 +11,7 @@ from llm_consensus_tpu.eval.gsm8k import (
     Problem,
     evaluate_self_consistency,
     exact_match,
+    few_shot_header,
     load_gsm8k,
     synthetic_problems,
 )
@@ -20,6 +21,7 @@ __all__ = [
     "Problem",
     "evaluate_self_consistency",
     "exact_match",
+    "few_shot_header",
     "load_gsm8k",
     "synthetic_problems",
 ]

@@ -49,6 +49,11 @@ class ModelConfig:
     # routed tokens, capacity = ceil(T*k/E * factor)); 0 = dense
     # all-experts compute (exact, E/k x the FLOPs).
     moe_capacity_factor: float = 0.0
+    # Router auxiliary loss weights for MoE TRAINING (Switch-style
+    # load-balance + router z-loss, models/transformer.moe_router_aux);
+    # inference ignores them.
+    moe_aux_loss_weight: float = 0.01
+    moe_z_loss_weight: float = 1e-3
     # Use the fused Pallas kernels (ops/pallas) for attention + RMSNorm on
     # the hot path; False = pure-XLA jnp reference ops.
     use_pallas: bool = False

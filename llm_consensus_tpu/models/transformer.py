@@ -247,11 +247,47 @@ def _project_qkv(cfg: ModelConfig, p: dict, h: jnp.ndarray):
     return q, k, v
 
 
-def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
+def moe_router_aux(
+    cfg: ModelConfig, router_logits: jnp.ndarray, top_idx: jnp.ndarray
+) -> dict:
+    """Router auxiliary losses for MoE training (Mixtral config).
+
+    router_logits: [..., E] pre-softmax; top_idx: [..., k] chosen experts.
+    Returns {"load_balance", "z_loss"} scalars:
+
+    - load_balance: Switch-Transformer style ``E * sum_e f_e * P_e``
+      where f_e is the fraction of (token, choice) assignments routed to
+      expert e and P_e the mean router probability mass — equals 1.0
+      under perfectly uniform routing, grows as experts collapse.
+    - z_loss: ``mean(logsumexp(logits)^2)`` — keeps router logits from
+      drifting to magnitudes where the softmax saturates.
+    """
+    e = cfg.n_experts
+    logits2 = router_logits.reshape(-1, e)
+    probs = jax.nn.softmax(logits2, axis=-1)
+    p_e = probs.mean(axis=0)  # [E]
+    assign = jax.nn.one_hot(top_idx.reshape(-1), e, dtype=jnp.float32)
+    f_e = assign.mean(axis=0)  # fraction of assignments per expert
+    load_balance = e * jnp.sum(f_e * p_e)
+    z = jnp.mean(jax.nn.logsumexp(logits2, axis=-1) ** 2)
+    return {"load_balance": load_balance, "z_loss": z}
+
+
+def _zero_aux() -> dict:
+    return {
+        "load_balance": jnp.zeros((), jnp.float32),
+        "z_loss": jnp.zeros((), jnp.float32),
+    }
+
+
+def _mlp(
+    cfg: ModelConfig, p: dict, h: jnp.ndarray, collect_aux: bool = False
+):
     if not cfg.is_moe:
-        return swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
+        y = swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
+        return (y, _zero_aux()) if collect_aux else y
     if cfg.moe_capacity_factor > 0:
-        return _moe_dispatch(cfg, p, h)
+        return _moe_dispatch(cfg, p, h, collect_aux=collect_aux)
     # Mixtral MoE: top-k routing, dense all-experts compute, weighted combine.
     router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, S, E]
     top_vals, top_idx = jax.lax.top_k(router_logits, cfg.n_experts_per_token)
@@ -265,12 +301,17 @@ def _mlp(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
     gate = jax.nn.silu(jnp.einsum("bsd,edf->bsef", h, _w(p["w_gate"])))
     up = jnp.einsum("bsd,edf->bsef", h, _w(p["w_up"]))
     expert_out = jnp.einsum("bsef,efd->bsed", gate * up, _w(p["w_down"]))
-    return jnp.einsum(
+    y = jnp.einsum(
         "bsed,bse->bsd", expert_out, combine.astype(expert_out.dtype)
     )
+    if collect_aux:
+        return y, moe_router_aux(cfg, router_logits, top_idx)
+    return y
 
 
-def _moe_dispatch(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
+def _moe_dispatch(
+    cfg: ModelConfig, p: dict, h: jnp.ndarray, collect_aux: bool = False
+):
     """GShard/Switch-style capacity-bounded expert dispatch.
 
     The dense path above computes EVERY expert for every token (E/k times
@@ -321,7 +362,10 @@ def _moe_dispatch(cfg: ModelConfig, p: dict, h: jnp.ndarray) -> jnp.ndarray:
     up = jnp.einsum("ecd,edf->ecf", xin, _w(p["w_up"]))
     out_e = jnp.einsum("ecf,efd->ecd", gate * up, _w(p["w_down"]))
     y = jnp.einsum("ecd,tec->td", out_e.astype(jnp.float32), combine)
-    return y.astype(h.dtype).reshape(b, s, d)
+    y = y.astype(h.dtype).reshape(b, s, d)
+    if collect_aux:
+        return y, moe_router_aux(cfg, router_logits, top_idx)
+    return y
 
 
 def _block(
@@ -336,6 +380,7 @@ def _block(
     positions: jnp.ndarray | None,
     uniform_write: bool = False,
     mesh=None,
+    collect_aux: bool = False,
 ):
     """One transformer block.
 
@@ -482,6 +527,9 @@ def _block(
 
     x = x + _qmm(attn.reshape(*x.shape[:-1], -1), p["wo"])
     h2 = _rms(cfg, x, p["mlp_norm"])
+    if collect_aux:
+        y, aux = _mlp(cfg, p, h2, collect_aux=True)
+        return x + y, new_kv, aux
     x = x + _mlp(cfg, p, h2)
     return x, new_kv
 
@@ -526,30 +574,43 @@ def _run_layers(
     remat: bool = False,
     uniform_write: bool = False,
     mesh=None,
+    collect_aux: bool = False,
 ):
     """lax.scan over the stacked layer axis (python-unrolled loop when
     ``params["blocks"]`` is a tuple of per-layer dicts — see
-    :func:`unstack_blocks`)."""
+    :func:`unstack_blocks`).
+
+    ``collect_aux`` (full mode only): also return the per-layer MoE
+    router aux losses averaged over layers ({"load_balance", "z_loss"}).
+    """
     blocks = params["blocks"]
 
     if isinstance(blocks, (list, tuple)):
         return _run_layers_unrolled(
             cfg, blocks, x, cos, sin, cache, mode, valid_len, positions,
             remat=remat, uniform_write=uniform_write, mesh=mesh,
+            collect_aux=collect_aux,
         )
 
     if mode == "full":
 
         def body(carry, p):
-            y, _ = _block(
+            out = _block(
                 cfg, p, carry, cos, sin, None, "full", None, positions,
-                mesh=mesh,
+                mesh=mesh, collect_aux=collect_aux,
             )
+            if collect_aux:
+                y, _, aux = out
+                return y, aux
+            y, _ = out
             return y, None
 
         if remat:
             body = jax.checkpoint(body)
-        x, _ = jax.lax.scan(body, x, blocks)
+        x, auxes = jax.lax.scan(body, x, blocks)
+        if collect_aux:
+            aux = jax.tree.map(jnp.mean, auxes)
+            return x, cache, aux
         return x, cache
 
     if isinstance(cache, QuantKVCache):
@@ -669,6 +730,7 @@ def _run_layers_unrolled(
     remat: bool = False,
     uniform_write: bool = False,
     mesh=None,
+    collect_aux: bool = False,
 ):
     """Python-unrolled layer loop over per-layer weight buffers.
 
@@ -679,14 +741,28 @@ def _run_layers_unrolled(
     step = _block
     if remat:
         step = jax.checkpoint(
-            _block, static_argnums=(0, 6), static_argnames=("uniform_write",)
+            _block,
+            static_argnums=(0, 6),
+            static_argnames=("uniform_write", "collect_aux"),
         )
 
     if mode == "full":
+        auxes = []
         for p in blocks:
-            x, _ = step(
-                cfg, p, x, cos, sin, None, "full", None, positions, mesh=mesh
+            out = step(
+                cfg, p, x, cos, sin, None, "full", None, positions,
+                mesh=mesh, collect_aux=collect_aux,
             )
+            if collect_aux:
+                x, _, aux = out
+                auxes.append(aux)
+            else:
+                x, _ = out
+        if collect_aux:
+            aux = jax.tree.map(
+                lambda *xs: jnp.mean(jnp.stack(xs)), *auxes
+            )
+            return x, cache, aux
         return x, cache
 
     quant = isinstance(cache, QuantKVCache)
@@ -733,12 +809,17 @@ def forward(
     positions: jnp.ndarray | None = None,
     remat: bool = False,
     mesh=None,
+    return_moe_aux: bool = False,
 ) -> jnp.ndarray:
     """Full causal forward: tokens [B, S] -> logits [B, S, V] (float32).
 
     ``mesh``: pass a mesh with ``seq > 1`` (and ``cfg.use_ring``) to run
     attention as sequence-parallel ring attention — the long-context
     path; trace-time constant, so it composes with jit.
+
+    ``return_moe_aux`` (static): also return the layer-averaged MoE
+    router aux losses ({"load_balance", "z_loss"} — zeros for dense
+    models) for the training loss.
     """
     x = params["embed"][tokens]
     if positions is None:
@@ -750,10 +831,14 @@ def forward(
     cos, sin = rope_cos_sin(
         positions_arr, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
-    x, _ = _run_layers(
+    out = _run_layers(
         cfg, params, x, cos, sin, None, "full", None, positions,
-        remat=remat, mesh=mesh,
+        remat=remat, mesh=mesh, collect_aux=return_moe_aux,
     )
+    if return_moe_aux:
+        x, _, aux = out
+        return _unembed(cfg, params, x), aux
+    x, _ = out
     return _unembed(cfg, params, x)
 
 

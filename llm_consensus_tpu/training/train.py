@@ -97,14 +97,34 @@ def causal_lm_loss(
     to the original-dtype masters). ``mesh`` routes attention through
     ring attention when ``cfg.use_ring`` and the mesh has ``seq > 1`` —
     true sequence parallelism, not just activation sharding.
+
+    MoE configs add the router auxiliary terms (load-balance + z-loss,
+    weighted by ``cfg.moe_aux_loss_weight`` / ``cfg.moe_z_loss_weight``)
+    — without them top-k routing collapses onto a few experts during
+    training (the Mixtral config, BASELINE.md config[2]).
     """
     params = _cast_params(params, compute_dtype)
-    logits = forward(cfg, params, tokens, remat=remat, mesh=mesh)
+    moe_aux = cfg.is_moe and (
+        cfg.moe_aux_loss_weight > 0 or cfg.moe_z_loss_weight > 0
+    )
+    if moe_aux:
+        logits, aux = forward(
+            cfg, params, tokens, remat=remat, mesh=mesh, return_moe_aux=True
+        )
+    else:
+        logits = forward(cfg, params, tokens, remat=remat, mesh=mesh)
     targets = tokens[:, 1:]
     lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
     mask = loss_mask[:, :-1].astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if moe_aux:
+        loss = (
+            loss
+            + cfg.moe_aux_loss_weight * aux["load_balance"]
+            + cfg.moe_z_loss_weight * aux["z_loss"]
+        )
+    return loss
 
 
 def init_train_state(

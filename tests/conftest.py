@@ -44,6 +44,28 @@ def cpu_devices():
     return devices
 
 
+def pytest_collection_modifyitems(config, items):
+    """Schedule the heaviest modules FIRST.
+
+    The GPipe pipeline tests compile shard_map programs whose peak
+    process memory exceeds what's left after an xdist worker has
+    accumulated several other modules' XLA:CPU state — the worker
+    aborts ("worker crashed") even though every test passes in
+    isolation. Heavy modules first means they land on fresh workers;
+    the light tail fills in afterwards. Stable sort preserves
+    within-module order.
+    """
+    heavy = (
+        "test_pipeline.py",
+        "test_train_loop.py",
+        "test_training.py",
+        "test_parallel.py",
+    )
+    items.sort(
+        key=lambda it: 0 if any(h in it.nodeid for h in heavy) else 1
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_xla_arena():
     """Clear JAX compile caches between test modules.

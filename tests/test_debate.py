@@ -173,8 +173,15 @@ def test_debate_validates_before_generating():
     with pytest.raises(ValueError, match="unknown debate vote method"):
         run_debate(ExplodingEngine(), "q", DebateConfig(method="typo"))
 
+    # No score_texts at all (e.g. a serving backend adapter).
+    with pytest.raises(ValueError, match="score_texts"):
+        run_debate(ExplodingEngine(), "q", DebateConfig(method="rescore"))
+
     class MeshEngine(ExplodingEngine):
         mesh = object()
 
-    with pytest.raises(ValueError, match="no mesh path"):
+        def score_texts(self, *a, **k):
+            raise AssertionError("must not score")
+
+    with pytest.raises(ValueError, match="score_texts and no"):
         run_debate(MeshEngine(), "q", DebateConfig(method="rescore"))

@@ -792,3 +792,19 @@ def test_engine_stats_counters(tiny):
     assert set(s["prefix_cache"]) == {
         "hits", "misses", "evictions", "entries", "bytes"
     }
+
+
+def test_stats_count_api_calls_not_chunks(tiny):
+    """Counters are per public API call even when batches chunk."""
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=4, seq_buckets=(16,), batch_buckets=(1, 2)
+        ),
+    )
+    eng.generate_texts(["a", "b", "c", "d", "e"])  # 3 chunks of <=2
+    eng.score_texts("p:", [" a", " b", " c"])  # 2 chunks
+    s = eng.stats()
+    assert s["calls"]["generate"] == 1
+    assert s["calls"]["score"] == 1
