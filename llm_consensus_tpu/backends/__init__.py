@@ -1,10 +1,26 @@
-from llm_consensus_tpu.backends.base import Backend, GenerationRequest, GenerationResult
+from llm_consensus_tpu.backends.base import (
+    Backend,
+    BackendError,
+    GenerationRequest,
+    GenerationResult,
+    SamplingParams,
+)
 from llm_consensus_tpu.backends.fake import FakeBackend, ScriptedBackend
+from llm_consensus_tpu.backends.fault import (
+    FaultConfig,
+    FaultInjectingBackend,
+    FaultStats,
+)
 
 __all__ = [
     "Backend",
+    "BackendError",
+    "FakeBackend",
+    "FaultConfig",
+    "FaultInjectingBackend",
+    "FaultStats",
     "GenerationRequest",
     "GenerationResult",
-    "FakeBackend",
+    "SamplingParams",
     "ScriptedBackend",
 ]

@@ -1,0 +1,98 @@
+"""Fault-injection backend wrapper (chaos testing for the protocol).
+
+The reference panics on ANY backend failure (`expect` at
+``src/main.rs:85,97,138,178``) and so cannot be chaos-tested at all;
+this framework's coordinator supervises its backend calls with timeouts
+and bounded retries (``consensus/coordinator.py``). This wrapper proves
+that supervision under adversarial conditions: it wraps any real
+:class:`~llm_consensus_tpu.backends.base.Backend` and injects seeded,
+reproducible faults —
+
+- **errors**: a call raises :class:`BackendError` with probability
+  ``error_rate`` (transient: the next retry of the same call may pass);
+- **delays**: a call sleeps ``delay_s`` seconds with probability
+  ``delay_rate`` (drives timeout paths without wall-clock-long tests);
+- **garbage**: a result's text is replaced with malformed output with
+  probability ``garbage_rate`` (exercises the verdict parser's
+  unknown-evaluation handling, SURVEY.md §5 quirk #4).
+
+Faults are drawn from a ``random.Random(seed)`` stream, so a failing
+chaos run reproduces exactly. Counters record what was injected.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+
+from llm_consensus_tpu.backends.base import (
+    Backend,
+    BackendError,
+    GenerationRequest,
+    GenerationResult,
+)
+
+
+@dataclass
+class FaultStats:
+    calls: int = 0
+    errors_injected: int = 0
+    delays_injected: int = 0
+    garbage_injected: int = 0
+
+
+@dataclass
+class FaultConfig:
+    error_rate: float = 0.0
+    delay_rate: float = 0.0
+    delay_s: float = 0.05
+    garbage_rate: float = 0.0
+    garbage_text: str = "?? GARBLED ??"
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("error_rate", "delay_rate", "garbage_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+
+
+class FaultInjectingBackend(Backend):
+    """Wrap ``inner`` with seeded transient errors, delays, and garbage."""
+
+    def __init__(self, inner: Backend, config: FaultConfig | None = None):
+        self.inner = inner
+        self.config = config or FaultConfig()
+        self._rng = random.Random(self.config.seed)
+        self.stats = FaultStats()
+
+    async def generate_batch(
+        self, requests: list[GenerationRequest]
+    ) -> list[GenerationResult]:
+        cfg = self.config
+        self.stats.calls += 1
+        if self._rng.random() < cfg.delay_rate:
+            self.stats.delays_injected += 1
+            await asyncio.sleep(cfg.delay_s)
+        if self._rng.random() < cfg.error_rate:
+            self.stats.errors_injected += 1
+            raise BackendError("injected transient fault")
+        results = await self.inner.generate_batch(requests)
+        out = []
+        for r in results:
+            if self._rng.random() < cfg.garbage_rate:
+                self.stats.garbage_injected += 1
+                out.append(
+                    GenerationResult(
+                        text=cfg.garbage_text,
+                        num_tokens=r.num_tokens,
+                        logprob=r.logprob,
+                    )
+                )
+            else:
+                out.append(r)
+        return out
+
+    async def close(self) -> None:
+        await self.inner.close()

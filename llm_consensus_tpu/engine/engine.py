@@ -477,6 +477,77 @@ class InferenceEngine:
             )
         return self._trim_stops(self._collect(out, n_real), stop)
 
+    def memory_estimate(
+        self,
+        n_candidates: int = 1,
+        prompt_len: int = 128,
+        new_tokens: int | None = None,
+        hbm_bytes: int | None = None,
+    ) -> dict:
+        """HBM budget estimate for a generate call at the given shapes.
+
+        Returns PER-CHIP bytes for resident params (target + any draft
+        model), the KV cache(s) a call would allocate (post-bucketing,
+        honoring ``kv_quant``; speculative decoding's draft cache
+        included when a draft is attached), the fp32 logits buffer, and
+        their total — plus ``fits`` when ``hbm_bytes`` is given (e.g.
+        16 GiB for one v5e chip). On a mesh, each term is divided by the
+        axes it shards over (params over model x expert, replicated
+        over data; cache/logits over data x model per ``cache_pspecs``).
+        Capacity planning for the N-way fan-out: "does N=64 at 4k
+        context fit?" without OOMing a real chip to find out.
+        """
+        from llm_consensus_tpu.ops.quant import quantized_bytes
+
+        cfg = self.cfg
+        s = min(
+            _next_bucket(prompt_len, self.config.seq_buckets),
+            cfg.max_seq_len,
+        )
+        mnt = new_tokens or self.config.max_new_tokens
+        mnt = max(1, min(mnt, cfg.max_seq_len - s))
+        b = _next_bucket(n_candidates, self.config.batch_buckets)
+        cache_len = s + mnt
+
+        def _kv_bytes(mcfg, quant, slack=0):
+            slots = mcfg.n_layers * b * (cache_len + slack) * mcfg.n_kv_heads
+            if quant:
+                # int8 k+v + one f32 scale each per (slot, head)
+                return slots * (2 * mcfg.head_dim + 2 * 4)
+            return slots * 2 * mcfg.head_dim * 2  # bf16 k+v
+
+        params_bytes = quantized_bytes(self.params)
+        kv = _kv_bytes(cfg, self.config.kv_quant)
+        if self.draft is not None:
+            d_cfg, d_params = self.draft
+            params_bytes += quantized_bytes(d_params)
+            # Speculative decoding holds bf16 target + draft caches.
+            kv += _kv_bytes(d_cfg, quant=False)
+        logits = b * cfg.vocab_size * 4
+        # Per-chip residency on a mesh: params shard over model x expert
+        # (replicated over data); the cache and batch shard over data and
+        # kv heads over model.
+        p_div = c_div = 1
+        if self.mesh is not None:
+            shape = dict(self.mesh.shape)
+            p_div = shape.get("model", 1) * shape.get("expert", 1)
+            c_div = shape.get("data", 1) * shape.get("model", 1)
+        params_bytes //= p_div
+        kv //= c_div
+        logits //= max(1, c_div)
+        total = params_bytes + kv + logits
+        out = {
+            "params_bytes": params_bytes,
+            "kv_cache_bytes": kv,
+            "logits_bytes": logits,
+            "total_bytes": total,
+            "batch": b,
+            "cache_len": cache_len,
+        }
+        if hbm_bytes is not None:
+            out["fits"] = total <= hbm_bytes
+        return out
+
     def stats(self) -> dict:
         """Lifetime engine counters (observability surface).
 

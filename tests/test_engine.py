@@ -808,3 +808,68 @@ def test_stats_count_api_calls_not_chunks(tiny):
     s = eng.stats()
     assert s["calls"]["generate"] == 1
     assert s["calls"]["score"] == 1
+
+
+def test_memory_estimate_scales_and_fits(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=8, seq_buckets=(16, 32), batch_buckets=(1, 2, 4)
+        ),
+    )
+    small = eng.memory_estimate(n_candidates=1, prompt_len=16)
+    big = eng.memory_estimate(n_candidates=4, prompt_len=16)
+    assert big["kv_cache_bytes"] == 4 * small["kv_cache_bytes"]
+    assert big["total_bytes"] > small["total_bytes"]
+    assert small["params_bytes"] > 0
+    assert eng.memory_estimate(1, 16, hbm_bytes=1 << 40)["fits"]
+    assert not eng.memory_estimate(1, 16, hbm_bytes=16)["fits"]
+    # int8 KV halves-ish the cache term vs bf16.
+    q = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=8, seq_buckets=(16, 32), batch_buckets=(1,),
+            kv_quant=True,
+        ),
+    )
+    assert (
+        q.memory_estimate(1, 16)["kv_cache_bytes"]
+        < small["kv_cache_bytes"]
+    )
+
+
+def test_memory_estimate_counts_draft_and_mesh(tiny):
+    """Draft models add their params + cache; meshes divide per chip."""
+    cfg, params = tiny
+    base = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=8, seq_buckets=(16,), batch_buckets=(1,)
+        ),
+    )
+    drafted = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=8, seq_buckets=(16,), batch_buckets=(1,)
+        ),
+        draft=(cfg, params),
+    )
+    mb, md = base.memory_estimate(1, 16), drafted.memory_estimate(1, 16)
+    assert md["params_bytes"] == 2 * mb["params_bytes"]
+    assert md["kv_cache_bytes"] > mb["kv_cache_bytes"]
+
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=4, model=2), jax.devices()[:8])
+    sharded = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            max_new_tokens=8, seq_buckets=(16,), batch_buckets=(4, 8)
+        ),
+        mesh=mesh,
+    )
+    ms = sharded.memory_estimate(4, 16)
+    assert ms["params_bytes"] == mb["params_bytes"] // 2  # model axis
+    # cache divides by data x model (batch also bucketed to 4 here vs 1)
+    assert ms["kv_cache_bytes"] < 4 * mb["kv_cache_bytes"] // 4
