@@ -92,13 +92,43 @@ def main() -> int:
         file=sys.stderr,
     )
 
-    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
-    if args.quant in ("int8", "int4"):
-        from llm_consensus_tpu.ops.quant import quantize_params
+    # Flagship-scale guard: init+quantize on-device holds bf16 AND the
+    # quantized copy at once (~24 GB for 8B int8) — OOM on a 16 GB v5e.
+    # Stage big models through host RAM (init_params_quantized) so the
+    # chip only ever sees the quantized tree.
+    from llm_consensus_tpu.engine.engine import plan_memory
 
-        params = quantize_params(
-            params, bits=8 if args.quant == "int8" else 4
-        )
+    bf16_plan = plan_memory(cfg, quant="none", n_candidates=1, prompt_len=8)
+    # Real device HBM when the backend reports it (a v5p-class chip can
+    # host-init 8B bf16 on-device; hardcoding v5e's 16 GiB would force
+    # the ~30 min host-staging path for nothing); 16 GiB fallback.
+    try:
+        hbm_budget = int(dev.memory_stats()["bytes_limit"])
+    except Exception:  # noqa: BLE001 - backend without memory stats
+        hbm_budget = 16 << 30 if dev.platform != "cpu" else 64 << 30
+    if args.quant in ("int8", "int4"):
+        bits = 8 if args.quant == "int8" else 4
+        if 2.2 * bf16_plan["params_bytes"] > hbm_budget:
+            from llm_consensus_tpu.models.transformer import (
+                init_params_quantized,
+            )
+
+            print(
+                "[bench] staging init+quantize through host RAM "
+                f"(bf16 {bf16_plan['params_bytes'] / 2**30:.1f} GiB "
+                "won't coexist with the quantized copy on-chip)",
+                file=sys.stderr,
+            )
+            params = init_params_quantized(
+                cfg, jax.random.PRNGKey(0), bits=bits, device=dev
+            )
+        else:
+            from llm_consensus_tpu.ops.quant import quantize_params
+
+            params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+            params = quantize_params(params, bits=bits)
+    else:
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     b, s = args.n_candidates, args.prompt_len
     tokens = jnp.ones((b, s), jnp.int32)
     lengths = jnp.full((b,), s, jnp.int32)

@@ -30,6 +30,12 @@ class SamplingParams:
     top_k: int = 0  # 0 => disabled
     top_p: float = 1.0  # 1.0 => disabled
     seed: int = 0
+    # Stop sequences: generation text is trimmed at the earliest
+    # occurrence (stop removed); backends end decoding early where their
+    # substrate allows (engine: single-token device stops + chunked
+    # host checks; continuous batcher: every token is host-checked).
+    # A tuple so the dataclass stays frozen/hashable.
+    stop: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)

@@ -72,16 +72,25 @@ class FaultInjectingBackend(Backend):
     ) -> list[GenerationResult]:
         cfg = self.config
         self.stats.calls += 1
-        if self._rng.random() < cfg.delay_rate:
+        # Draw EVERY fault decision for this call synchronously, before
+        # any await: concurrent generate_batch calls (the coordinator
+        # gathers panelists) would otherwise consume the shared RNG
+        # stream in task-completion order, breaking seeded reproduction.
+        delay = self._rng.random() < cfg.delay_rate
+        error = self._rng.random() < cfg.error_rate
+        garbage = [
+            self._rng.random() < cfg.garbage_rate for _ in requests
+        ]
+        if delay:
             self.stats.delays_injected += 1
             await asyncio.sleep(cfg.delay_s)
-        if self._rng.random() < cfg.error_rate:
+        if error:
             self.stats.errors_injected += 1
             raise BackendError("injected transient fault")
         results = await self.inner.generate_batch(requests)
         out = []
-        for r in results:
-            if self._rng.random() < cfg.garbage_rate:
+        for r, garbled in zip(results, garbage):
+            if garbled:
                 self.stats.garbage_injected += 1
                 out.append(
                     GenerationResult(

@@ -70,6 +70,7 @@ class LocalBackend(Backend):
                 req.params.max_new_tokens,
                 req.params.top_k,
                 req.params.top_p,
+                req.params.stop,
             )
             groups[key].append(i)
             engines[key] = eng
@@ -79,7 +80,7 @@ class LocalBackend(Backend):
         def _run(key: tuple, eng: InferenceEngine, idxs: list[int]) -> None:
             from llm_consensus_tpu.engine.sampler import SamplerConfig
 
-            _, max_new, top_k, top_p = key
+            _, max_new, top_k, top_p, stop = key
             reqs = [requests[i] for i in idxs]
             # All-greedy groups ride speculative decoding when the
             # engine carries a draft model — safe because greedy
@@ -95,6 +96,7 @@ class LocalBackend(Backend):
                 and eng.config.prefill_chunk == 0
                 and top_k == 0
                 and top_p == 1.0
+                and not stop  # the speculative program has no stop path
                 and all(r.params.temperature == 0.0 for r in reqs)
             ):
                 outs = eng.generate_texts_speculative(
@@ -110,6 +112,7 @@ class LocalBackend(Backend):
                     seed=reqs[0].params.seed,
                     max_new_tokens=max_new,
                     sampler=SamplerConfig(top_k=top_k, top_p=top_p),
+                    stop=list(stop) or None,
                 )
             for i, out in zip(idxs, outs):
                 results[i] = GenerationResult(

@@ -99,13 +99,10 @@ def run_debate(
     # engine must not burn an N-candidate TPU round first.
     if cfg.method not in ("majority", "logit_pool", "rescore"):
         raise ValueError(f"unknown debate vote method {cfg.method!r}")
-    if cfg.method == "rescore" and (
-        not hasattr(engine, "score_texts")
-        or getattr(engine, "mesh", None) is not None
-    ):
+    if cfg.method == "rescore" and not hasattr(engine, "score_texts"):
         raise ValueError(
-            "method='rescore' needs an engine with score_texts and no "
-            "mesh — use a single-device judge engine or another method"
+            "method='rescore' needs an engine with score_texts "
+            "(sharded engines included: completions shard over data)"
         )
     n = cfg.n_candidates
     rounds: list[DebateRound] = []

@@ -22,7 +22,7 @@ from __future__ import annotations
 import re
 from collections import defaultdict
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -144,22 +144,58 @@ def rescore_vote(
     sample itself. ``normalize`` length-normalizes so verbose answers
     aren't penalized linearly.
     """
-    nonempty = [a for a in answers if a]
+    # Scorability is a TOKEN property, not a string one: an answer that
+    # a tokenizer encodes to zero ids (possible with HF tokenizers on
+    # e.g. control-char-only text) cannot be teacher-forced any more
+    # than "" can. Both pool with ~zero mass instead of erroring.
+    tok = getattr(engine, "tokenizer", None)
+
+    def _scorable(a: str) -> bool:
+        if not a:
+            return False
+        if tok is None:
+            return True
+        return len(tok.encode(a, add_bos=False)) > 0
+
+    scorable = [_scorable(a) for a in answers]
+    picked = [a for a, ok in zip(answers, scorable) if ok]
     scored = (
-        engine.score_texts(prompt, nonempty, normalize=normalize)
-        if nonempty
+        engine.score_texts(prompt, picked, normalize=normalize)
+        if picked
         else []
     )
     it = iter(scored)
-    # Empty answers (a candidate that emitted EOS immediately) cannot be
-    # teacher-forced; they pool with ~zero mass instead of erroring.
-    scores = [next(it) if a else -1e30 for a in answers]
+    scores = [next(it) if ok else -1e30 for ok in scorable]
     return logit_pool(answers, scores, key_fn)
 
 
 # ---------------------------------------------------------------------------
 # On-device reducer (north-star: all-gather/psum + argmax over candidates)
 # ---------------------------------------------------------------------------
+
+
+# jit cache keys on function identity — a fresh shard_map closure per
+# vote would recompile every call. One jitted reducer per
+# (mesh, n_classes, axis_name); repeat votes on the same mesh hit it.
+# lru_cache bounds retention: a long-lived process churning through
+# distinct meshes must not pin every mesh + executable forever.
+@lru_cache(maxsize=16)
+def _vote_reducer(mesh: Mesh, n_classes: int, axis_name: str):
+    def tally(ids, w):
+        onehot = jax.nn.one_hot(ids, n_classes, dtype=jnp.float32)
+        local = jnp.sum(onehot * w[:, None], axis=0)
+        hist = jax.lax.psum(local, axis_name)
+        return jnp.argmax(hist).astype(jnp.int32), hist
+
+    spec = P(axis_name)
+    return jax.jit(
+        jax.shard_map(
+            tally,
+            mesh=mesh,
+            in_specs=(spec, spec),
+            out_specs=(P(), P()),
+        )
+    )
 
 
 def device_majority_vote(
@@ -180,21 +216,9 @@ def device_majority_vote(
     """
     if weights is None:
         weights = jnp.ones_like(candidate_ids, jnp.float32)
-
-    def tally(ids, w):
-        onehot = jax.nn.one_hot(ids, n_classes, dtype=jnp.float32)
-        local = jnp.sum(onehot * w[:, None], axis=0)
-        hist = jax.lax.psum(local, axis_name)
-        return jnp.argmax(hist).astype(jnp.int32), hist
-
-    spec = P(axis_name)
-    fn = jax.shard_map(
-        tally,
-        mesh=mesh,
-        in_specs=(spec, spec),
-        out_specs=(P(), P()),
+    winner, hist = _vote_reducer(mesh, n_classes, axis_name)(
+        candidate_ids, weights
     )
-    winner, hist = jax.jit(fn)(candidate_ids, weights)
     return int(winner), np.asarray(hist)
 
 

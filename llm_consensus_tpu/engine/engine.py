@@ -48,6 +48,23 @@ def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
     return buckets[-1]
 
 
+def _kv_cache_bytes(
+    cfg: ModelConfig, batch: int, cache_len: int, quant: bool, slack: int = 0
+) -> int:
+    """KV-cache bytes for a generate call — the ONE copy of the cache
+    capacity formula (memory_estimate and plan_memory both call it, so
+    a cache-layout change cannot silently drift between them)."""
+    slots = cfg.n_layers * batch * (cache_len + slack) * cfg.n_kv_heads
+    if quant:
+        # int8 k+v + one f32 scale each per (slot, head)
+        return slots * (2 * cfg.head_dim + 2 * 4)
+    return slots * 2 * cfg.head_dim * 2  # bf16 k+v
+
+
+def _logits_bytes(cfg: ModelConfig, batch: int) -> int:
+    return batch * cfg.vocab_size * 4
+
+
 @dataclass
 class EngineConfig:
     max_new_tokens: int = 256
@@ -72,6 +89,12 @@ class EngineConfig:
     # and their K/V reused across calls. Entry/byte budgets bound HBM.
     prefix_cache_entries: int = 8
     prefix_cache_bytes: int = 1 << 30
+    # Decode-steps-per-host-check when a call carries MULTI-token stop
+    # sequences: the device can only terminate single-token stops, so
+    # the engine decodes in chunks this long and checks texts between
+    # chunks — a '\n\n'-style stop ends decoding within one chunk
+    # instead of running every row to EOS/max_new_tokens.
+    stop_check_chunk: int = 16
     # Single-chip experiment: per-layer weight buffers + python-unrolled
     # layer loop (models.transformer.unstack_blocks). Measured SLOWER
     # than the stacked scan on v5e at bench shapes (the scan pipelines
@@ -246,11 +269,12 @@ class InferenceEngine:
         ``prefix``: a shared prompt prefix — the effective prompt for row
         i is ``prefix + prompts[i]``. The prefix's K/V is prefilled once
         and cached on device (``self.prefix_cache``), so later calls with
-        the same prefix skip its prefill entirely. Falls back to plain
-        concatenated generation on sharded engines / quant KV caches
-        (no chunk-continuation path there). Prefix and suffix are
-        tokenized separately (the universal prefix-caching caveat: for
-        merge-based tokenizers, split at a whitespace/newline boundary).
+        the same prefix skip its prefill entirely — including on sharded
+        engines (batch over ``data``, B=1 prefix broadcast) and quant-KV
+        engines (stored bf16 header quantized into the int8 cache on
+        entry). Prefix and suffix are tokenized separately (the universal
+        prefix-caching caveat: for merge-based tokenizers, split at a
+        whitespace/newline boundary).
 
         ``stop``: stop sequences. Generation text is trimmed at the
         earliest occurrence of any stop string (the stop itself is
@@ -284,15 +308,15 @@ class InferenceEngine:
                 )
             return out
         if prefix:
-            if self.mesh is None and not self.config.kv_quant:
-                return self._generate_with_prefix(
-                    prompts, prefix, temperatures, seed, max_new_tokens,
-                    sampler, stop,
-                )
-            # No chunk-continuation path for sharded/quant caches — same
-            # output via plain generation on the concatenated prompts.
-            log.debug("prefix cache bypassed (mesh/kv_quant engine)")
-            prompts = [prefix + p for p in prompts]
+            # Mesh engines shard the continuation batch over `data`
+            # (GSPMD broadcasts the B=1 prefix); kv_quant engines
+            # quantize the stored bf16 prefix into the int8 cache on
+            # entry — the prefix cache works on exactly the north-star
+            # sharded/quantized configs that reuse headers the most.
+            return self._generate_with_prefix(
+                prompts, prefix, temperatures, seed, max_new_tokens,
+                sampler, stop,
+            )
         tokens, lengths, n_real = self._prepare(prompts)
         with self._span(
             "engine.generate",
@@ -306,6 +330,29 @@ class InferenceEngine:
             )
 
     # -- prefix-cached generation --------------------------------------
+
+    def _cache_sharding(self, cache):
+        """NamedSharding pytree for a KV cache on this engine's mesh:
+        batch over ``data``, kv heads over ``model`` (the
+        ``partitioning.cache_pspecs`` layout, covering both cache
+        classes — the quant cache is head-major so ``model`` rides
+        axis 2)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from llm_consensus_tpu.models.cache import QuantKVCache
+
+        mesh = self.mesh
+        ln = NamedSharding(mesh, P("data"))
+        if isinstance(cache, QuantKVCache):
+            s5 = NamedSharding(mesh, P(None, "data", "model"))
+            return QuantKVCache(
+                k_q=s5, v_q=s5, k_scale=s5, v_scale=s5, length=ln
+            )
+        from llm_consensus_tpu.models.cache import KVCache
+
+        s5 = NamedSharding(mesh, P(None, "data", None, "model"))
+        return KVCache(k=s5, v=s5, length=ln)
 
     def _stop_ids(self, stop: list[str] | None) -> tuple[int, ...]:
         """Stops that tokenize to exactly one id terminate on device."""
@@ -328,11 +375,10 @@ class InferenceEngine:
         """
         if not stop:
             return results
+        from llm_consensus_tpu.utils.stops import earliest_stop_cut
+
         for r in results:
-            cut = min(
-                (i for s in stop if (i := r.text.find(s)) >= 0),
-                default=-1,
-            )
+            cut = earliest_stop_cut(r.text, stop)
             if cut >= 0:
                 r.text = r.text[:cut]
         return results
@@ -451,6 +497,46 @@ class InferenceEngine:
         # Identical suffixes (self-consistency fan-out under a cached
         # header): chunk the suffix once at B=1 and broadcast.
         shared = n_real == b and len(set(prompts)) == 1 and b > 1
+        tokens_j = jnp.asarray(tokens)
+        lengths_j = jnp.asarray(lengths)
+        temps_j = jnp.asarray(temps)
+        if self._data_sharding is not None:
+            tokens_j = jax.device_put(tokens_j, self._data_sharding)
+            lengths_j = jax.device_put(lengths_j, self._data_sharding)
+            temps_j = jax.device_put(temps_j, self._data_sharding)
+        multi_stop = stop and any(
+            len(self.tokenizer.encode(x, add_bos=False)) > 1 for x in stop
+        )
+        if multi_stop:
+            # Prefix-cached generation with multi-token stops rides the
+            # same chunked host-checked decode as the plain path: the
+            # header reuse and the early exit compose instead of the
+            # prefix workload silently decoding to EOS/max_new_tokens.
+            from llm_consensus_tpu.engine.generate import prefill_from_prefix
+
+            with self._span(
+                "engine.generate_prefix_chunked_stops",
+                batch=b,
+                prefix=p,
+                seq=s,
+                n_real=n_real,
+            ):
+                logits, cache = prefill_from_prefix(
+                    self.cfg,
+                    self.params,
+                    pk,
+                    pv,
+                    jnp.asarray(p, jnp.int32),
+                    tokens_j,
+                    lengths_j,
+                    cache_len=pb + s + mnt,
+                    shared_suffix=shared,
+                    kv_quant=self.config.kv_quant,
+                )
+                return self._chunked_stop_decode(
+                    logits, cache, temps_j, n_real, seed, mnt, sampler,
+                    stop,
+                )
         with self._span(
             "engine.generate_prefix",
             batch=b,
@@ -464,16 +550,17 @@ class InferenceEngine:
                 pk,
                 pv,
                 jnp.asarray(p, jnp.int32),
-                jnp.asarray(tokens),
-                jnp.asarray(lengths),
+                tokens_j,
+                lengths_j,
                 jax.random.PRNGKey(seed),
-                jnp.asarray(temps),
+                temps_j,
                 max_new_tokens=mnt,
                 sampler=sampler if sampler is not None else self.config.sampler,
                 eos_id=self.tokenizer.eos_id,
                 pad_id=self.tokenizer.pad_id,
                 stop_ids=self._stop_ids(stop),
                 shared_suffix=shared,
+                kv_quant=self.config.kv_quant,
             )
         return self._trim_stops(self._collect(out, n_real), stop)
 
@@ -509,30 +596,32 @@ class InferenceEngine:
         b = _next_bucket(n_candidates, self.config.batch_buckets)
         cache_len = s + mnt
 
-        def _kv_bytes(mcfg, quant, slack=0):
-            slots = mcfg.n_layers * b * (cache_len + slack) * mcfg.n_kv_heads
-            if quant:
-                # int8 k+v + one f32 scale each per (slot, head)
-                return slots * (2 * mcfg.head_dim + 2 * 4)
-            return slots * 2 * mcfg.head_dim * 2  # bf16 k+v
-
-        params_bytes = quantized_bytes(self.params)
-        kv = _kv_bytes(cfg, self.config.kv_quant)
+        kv = _kv_cache_bytes(cfg, b, cache_len, self.config.kv_quant)
         if self.draft is not None:
             d_cfg, d_params = self.draft
-            params_bytes += quantized_bytes(d_params)
             # Speculative decoding holds bf16 target + draft caches.
-            kv += _kv_bytes(d_cfg, quant=False)
-        logits = b * cfg.vocab_size * 4
-        # Per-chip residency on a mesh: params shard over model x expert
-        # (replicated over data); the cache and batch shard over data and
-        # kv heads over model.
-        p_div = c_div = 1
+            kv += _kv_cache_bytes(d_cfg, b, cache_len, quant=False)
+        logits = _logits_bytes(cfg, b)
+        # Per-chip residency on a mesh: each param leaf divides by the
+        # axes its OWN PartitionSpec names (replicated leaves — embeds,
+        # norms, and on MoE models all non-expert weights — do not
+        # shrink); the cache and batch shard over data and kv heads
+        # over model.
+        c_div = 1
         if self.mesh is not None:
+            from llm_consensus_tpu.parallel.partitioning import (
+                sharded_param_bytes,
+            )
+
             shape = dict(self.mesh.shape)
-            p_div = shape.get("model", 1) * shape.get("expert", 1)
+            params_bytes = sharded_param_bytes(self.params, shape)
+            if self.draft is not None:
+                params_bytes += sharded_param_bytes(self.draft[1], shape)
             c_div = shape.get("data", 1) * shape.get("model", 1)
-        params_bytes //= p_div
+        else:
+            params_bytes = quantized_bytes(self.params)
+            if self.draft is not None:
+                params_bytes += quantized_bytes(self.draft[1])
         kv //= c_div
         logits //= max(1, c_div)
         total = params_bytes + kv + logits
@@ -626,6 +715,14 @@ class InferenceEngine:
             tokens_j = jax.device_put(tokens_j, self._data_sharding)
             lengths_j = jax.device_put(lengths_j, self._data_sharding)
             temps_j = jax.device_put(temps_j, self._data_sharding)
+        multi_stop = stop and any(
+            len(self.tokenizer.encode(x, add_bos=False)) > 1 for x in stop
+        )
+        if multi_stop:
+            return self._generate_chunked_stops(
+                tokens_j, lengths_j, temps_j, n_real, seed, mnt, sampler,
+                stop, shared,
+            )
         out: GenerateOutput = generate(
             self.cfg,
             self.params,
@@ -644,6 +741,153 @@ class InferenceEngine:
             mesh=self.mesh if self.cfg.use_ring else None,
             prefill_chunk=self.config.prefill_chunk,
             stop_ids=self._stop_ids(stop),
+        )
+        return self._trim_stops(self._collect(out, n_real), stop)
+
+    def _generate_chunked_stops(
+        self, tokens_j, lengths_j, temps_j, n_real, seed, mnt, sampler,
+        stop, shared,
+    ) -> list[EngineResult]:
+        """Batch generation with MULTI-token stop sequences: decode in
+        ``stop_check_chunk``-step device calls with host text checks
+        between them, so stops like ``"\\n\\n"`` (several ids under any
+        tokenizer) end decoding within one chunk instead of every row
+        burning steps to EOS/max_new_tokens.
+
+        Greedy output text matches the one-shot path exactly (modulo the
+        earlier cutoff); sampled rows draw per-chunk PRNG subkeys (the
+        ``generate_stream`` convention) — deterministic per seed, but a
+        different stream than the no-stop program. A row whose text
+        contains a stop is marked done on device at the next chunk
+        boundary, so ``num_tokens``/``logprob`` stay honest about what
+        was actually decoded (at most one chunk of overshoot)."""
+        from llm_consensus_tpu.engine.generate import prefill_into_cache
+
+        b, s = tokens_j.shape
+        with self._span(
+            "engine.generate_chunked_stops", batch=b, seq=s, n_real=n_real
+        ):
+            logits, cache = prefill_into_cache(
+                self.cfg,
+                self.params,
+                tokens_j,
+                lengths_j,
+                cache_len=s + mnt,
+                shared_prefill=shared,
+                kv_quant=self.config.kv_quant,
+                mesh=self.mesh if self.cfg.use_ring else None,
+                prefill_chunk=self.config.prefill_chunk,
+            )
+            return self._chunked_stop_decode(
+                logits, cache, temps_j, n_real, seed, mnt, sampler, stop
+            )
+
+    def _chunked_stop_decode(
+        self, logits, cache, temps_j, n_real, seed, mnt, sampler, stop
+    ) -> list[EngineResult]:
+        """The decode half of the chunked multi-token-stop path, from
+        first-token logits + a filled cache onward — shared by the plain
+        batch path and the prefix-cached path (both prefill differently
+        but stop identically)."""
+        from llm_consensus_tpu.engine.generate import (
+            GenerateOutput,
+            decode_steps,
+        )
+
+        tok_ = self.tokenizer
+        b = logits.shape[0]
+        sampler_cfg = sampler if sampler is not None else self.config.sampler
+        stop_ids = self._stop_ids(stop)
+        terminal = {tok_.eos_id, *stop_ids}
+        with self._span(
+            "engine.chunked_stop_decode", batch=b, n_real=n_real
+        ):
+            key = jax.random.PRNGKey(seed)
+            tok, lp0 = _jit_sample(
+                logits, jax.random.fold_in(key, 0), temps_j, sampler_cfg
+            )
+            toks0 = np.asarray(tok)
+            done_np = np.array([int(t) in terminal for t in toks0])
+            lp_sum = np.asarray(lp0, np.float32).copy()
+            cols_toks = [toks0[:, None].astype(np.int32)]
+            cols_live = [np.ones((b, 1), bool)]
+            stop_hit = np.zeros((b,), bool)
+            done = jnp.asarray(done_np)
+            if self._data_sharding is not None:
+                done = jax.device_put(done, self._data_sharding)
+            produced = 1
+            chunk = max(1, self.config.stop_check_chunk)
+            chunk_i = 0
+            # Per-row incremental id streams + tail-window stop checks:
+            # decoding each row's full history every chunk would be
+            # O(T^2/chunk) host work (the continuous batcher's _hit_stop
+            # learned the same lesson). The final _trim_stops pass
+            # guarantees exact text regardless of the window.
+            from llm_consensus_tpu.utils.stops import stop_tail_window
+
+            win = stop_tail_window(tok_, stop)
+            row_ids: list[list[int]] = [
+                [] if done_np[r] else [int(toks0[r])] for r in range(n_real)
+            ]
+
+            def _row_stopped(r: int) -> bool:
+                text = tok_.decode(row_ids[r][-win:])
+                return any(x in text for x in stop)
+
+            while produced < mnt:
+                active = [
+                    r
+                    for r in range(n_real)
+                    if not done_np[r] and not stop_hit[r]
+                ]
+                if not active:
+                    break
+                k = min(chunk, mnt - produced)
+                chunk_i += 1
+                out, live, cache, done, tok, lp = decode_steps(
+                    self.cfg,
+                    self.params,
+                    cache,
+                    tok,
+                    done,
+                    jax.random.fold_in(key, chunk_i),
+                    temps_j,
+                    steps=chunk,
+                    sampler=sampler_cfg,
+                    eos_id=tok_.eos_id,
+                    pad_id=tok_.pad_id,
+                    stop_ids=stop_ids,
+                )
+                out_np = np.asarray(out)[:, :k].astype(np.int32)
+                live_np = np.asarray(live)[:, :k]
+                cols_toks.append(out_np)
+                cols_live.append(live_np)
+                # Per-step logprobs, truncated to the consumed prefix —
+                # tail-chunk overshoot must not inflate the sum.
+                lp_sum += np.asarray(lp, np.float32)[:, :k].sum(axis=1)
+                produced += k
+                done_np = np.asarray(done).copy()
+                for r in active:
+                    row_ids[r].extend(
+                        int(t)
+                        for t, alive in zip(out_np[r], live_np[r])
+                        if alive and int(t) not in terminal
+                    )
+                    if not done_np[r] and _row_stopped(r):
+                        stop_hit[r] = True
+                if stop_hit.any():
+                    # Stopped rows go done on device: they stop burning
+                    # logprob accumulation and emit pad from here on.
+                    done = jnp.asarray(done_np | stop_hit)
+                    if self._data_sharding is not None:
+                        done = jax.device_put(done, self._data_sharding)
+
+        tokens_arr = np.concatenate(cols_toks, axis=1)
+        live_arr = np.concatenate(cols_live, axis=1)
+        out = GenerateOutput(
+            tokens=jnp.asarray(tokens_arr),
+            num_tokens=jnp.asarray(live_arr.sum(axis=1).astype(np.int32)),
+            logprob_sum=jnp.asarray(lp_sum),
         )
         return self._trim_stops(self._collect(out, n_real), stop)
 
@@ -667,27 +911,22 @@ class InferenceEngine:
         Greedy streaming concatenates to exactly ``generate_texts``'s
         output; sampled streams draw per-chunk PRNG subkeys. Stop
         sequences are honored across chunk boundaries. Sharded engines
-        fall back to one non-incremental yield.
+        stream incrementally too: the single request pads to the data
+        axis (dummy greedy rows beyond row 0) and the cache/batch shard
+        as in ``generate_texts`` — the REPL sees tokens as they decode
+        on the north-star config, not one blocking yield.
         """
         self._calls["stream"] += 1
-        if self.mesh is not None:
-            r = self.generate_texts(
-                [prompt],
-                temperatures=[temperature],
-                seed=seed,
-                max_new_tokens=max_new_tokens,
-                sampler=sampler,
-                stop=stop,
-                _outer=False,
-            )[0]
-            if r.text:
-                yield r.text
-            return
         from llm_consensus_tpu.engine.generate import decode_steps
         from llm_consensus_tpu.models.cache import KVCache, QuantKVCache
 
         tok_ = self.tokenizer
         tokens, lengths, _ = self._prepare([prompt])
+        if self.mesh is None:
+            # _prepare pads to the batch bucket; the stream decodes one
+            # row. On a mesh the bucketed batch stays (it tiles `data`).
+            tokens, lengths = tokens[:1], lengths[:1]
+        b = tokens.shape[0]
         s = tokens.shape[1]
         mnt = max_new_tokens or self.config.max_new_tokens
         mnt = max(1, min(mnt, self.cfg.max_seq_len - s))
@@ -700,10 +939,17 @@ class InferenceEngine:
         make_cache = (
             QuantKVCache.create if self.config.kv_quant else KVCache.create
         )
-        cache = make_cache(self.cfg, 1, s + mnt)
-        # _prepare pads to the batch bucket; the stream decodes one row.
-        tokens_j = jnp.asarray(tokens[:1])
-        lengths_j = jnp.asarray(lengths[:1])
+        cache = make_cache(self.cfg, b, s + mnt)
+        tokens_j = jnp.asarray(tokens)
+        lengths_j = jnp.asarray(lengths)
+        temps_np = np.zeros((b,), np.float32)
+        temps_np[0] = temperature
+        temps = jnp.asarray(temps_np)
+        if self._data_sharding is not None:
+            tokens_j = jax.device_put(tokens_j, self._data_sharding)
+            lengths_j = jax.device_put(lengths_j, self._data_sharding)
+            temps = jax.device_put(temps, self._data_sharding)
+            cache = jax.device_put(cache, self._cache_sharding(cache))
         if (
             self.config.prefill_chunk
             and s > self.config.prefill_chunk
@@ -718,13 +964,15 @@ class InferenceEngine:
                 self.cfg, self.params, tokens_j, lengths_j, cache
             )
         key = jax.random.PRNGKey(seed)
-        temps = jnp.asarray([temperature], jnp.float32)
         tok, _ = _jit_sample(
             logits, jax.random.fold_in(key, 0), temps, sampler_cfg
         )
-        first = int(tok[0])
+        toks_np = np.asarray(tok)
+        first = int(toks_np[0])
         ids: list[int] = [] if first in terminal else [first]
-        done = jnp.asarray([first in terminal])
+        done = jnp.asarray([int(t) in terminal for t in toks_np])
+        if self._data_sharding is not None:
+            done = jax.device_put(done, self._data_sharding)
         self._tokens_generated += 1
         yielded = 0
 
@@ -735,8 +983,10 @@ class InferenceEngine:
             then be trimmed, never emitted) and (b) trailing replacement
             chars from split multi-byte sequences."""
             nonlocal yielded
+            from llm_consensus_tpu.utils.stops import earliest_stop_cut
+
             t = tok_.decode(ids)
-            cut = min((i for x in stop if (i := t.find(x)) >= 0), default=-1)
+            cut = earliest_stop_cut(t, stop)
             finished = cut >= 0
             if finished:
                 t = t[:cut]
@@ -820,12 +1070,13 @@ class InferenceEngine:
         lengths). Candidates can come from anywhere — another model of
         a heterogeneous panel, a debate round, a human draft — making
         this the reranking/logit-pooling half of answer aggregation.
-        bf16 cache, single-device/data-replicated params.
+        bf16 cache. On a mesh the completion rows shard over ``data``
+        (the prompt and its B=1 prefill replicate; GSPMD broadcasts the
+        cache into the sharded batch) — judge rescoring works on the
+        north-star sharded config, same numbers as single-device.
         """
         if not completions:
             return []
-        if self.mesh is not None:
-            raise ValueError("score_texts is single-device (no mesh path)")
         if _outer:
             self._calls["score"] += 1
         # Batches beyond the largest bucket score in chunks.
@@ -871,16 +1122,29 @@ class InferenceEngine:
         clens[: len(comp)] = [len(c) for c in comp]
         ptoks = np.full((1, sp), tok.pad_id, np.int32)
         ptoks[0, :p] = p_ids
+        ptoks_j = jnp.asarray(ptoks)
+        plen_j = jnp.asarray([p], jnp.int32)
+        ctoks_j = jnp.asarray(ctoks)
+        clens_j = jnp.asarray(clens)
+        if self._data_sharding is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            ptoks_j = jax.device_put(ptoks_j, rep)
+            plen_j = jax.device_put(plen_j, rep)
+            ctoks_j = jax.device_put(ctoks_j, self._data_sharding)
+            clens_j = jax.device_put(clens_j, self._data_sharding)
         with self._span(
             "engine.score", batch=b, prompt=p, k=k, n_real=len(comp)
         ):
             sums, _ = score_completions(
                 self.cfg,
                 self.params,
-                jnp.asarray(ptoks),
-                jnp.asarray([p], jnp.int32),
-                jnp.asarray(ctoks),
-                jnp.asarray(clens),
+                ptoks_j,
+                plen_j,
+                ctoks_j,
+                clens_j,
                 cache_len=sp + k,
             )
         out = np.asarray(sums)[: len(comp)].tolist()
@@ -951,3 +1215,84 @@ class InferenceEngine:
                 pad_id=self.tokenizer.pad_id,
             )
         return self._collect(out, n_real)
+
+
+def plan_memory(
+    cfg: ModelConfig,
+    *,
+    quant: str = "none",
+    kv_quant: bool = False,
+    n_candidates: int = 1,
+    prompt_len: int = 128,
+    new_tokens: int = 256,
+    mesh_shape: dict | None = None,
+    hbm_bytes: int | None = None,
+    seq_buckets: tuple[int, ...] | None = None,
+    batch_buckets: tuple[int, ...] | None = None,
+) -> dict:
+    """Config-only HBM plan — no weights are ever allocated.
+
+    The capacity-planning companion to :meth:`InferenceEngine.
+    memory_estimate` for models too large to instantiate first (the
+    question "can Mixtral-8x7B fit one v5e chip?" must be answerable
+    without OOMing one). Param bytes come from ``jax.eval_shape`` over
+    ``init_params`` + ``quantize_params`` — exact leaf-for-leaf sizes,
+    zero allocation. KV/logit math matches ``memory_estimate``,
+    INCLUDING the engine's shape bucketing: ``n_candidates``/
+    ``prompt_len`` round up to ``batch_buckets``/``seq_buckets``
+    (defaults = ``EngineConfig``'s) exactly as a real generate call
+    would, so the ``fits`` verdict reflects what the engine actually
+    allocates, not the raw request. Pass ``buckets=()``-style overrides
+    to mirror a custom engine config. ``mesh_shape`` (e.g.
+    ``{"data": 4, "model": 2}``) divides each term by the axes it
+    shards over.
+    """
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.ops.quant import quantize_params, quantized_bytes
+
+    tree = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    )
+    if quant in ("int8", "int4"):
+        bits = 8 if quant == "int8" else 4
+        tree = jax.eval_shape(lambda t: quantize_params(t, bits=bits), tree)
+
+    dflt = EngineConfig()
+    sb = seq_buckets if seq_buckets is not None else dflt.seq_buckets
+    bb = batch_buckets if batch_buckets is not None else dflt.batch_buckets
+    s = min(_next_bucket(prompt_len, sb), cfg.max_seq_len)
+    b = _next_bucket(n_candidates, bb)
+    mnt = max(1, min(new_tokens, cfg.max_seq_len - s))
+    cache_len = s + mnt
+    kv = _kv_cache_bytes(cfg, b, cache_len, kv_quant)
+    logits = _logits_bytes(cfg, b)
+
+    shape = dict(mesh_shape or {})
+    if any(v > 1 for v in shape.values()):
+        # Per-leaf division by the axes each leaf's PartitionSpec names:
+        # on MoE models only the expert FFN stacks shard over `expert`;
+        # attention/embeds/norms replicate and must count at full size
+        # per chip (a global model*expert divide understates residency
+        # and can claim a config fits when it OOMs).
+        from llm_consensus_tpu.parallel.partitioning import (
+            sharded_param_bytes,
+        )
+
+        params_bytes = sharded_param_bytes(tree, shape)
+    else:
+        params_bytes = quantized_bytes(tree)
+    c_div = shape.get("data", 1) * shape.get("model", 1)
+    kv //= c_div
+    logits //= max(1, c_div)
+    total = params_bytes + kv + logits
+    out = {
+        "params_bytes": params_bytes,
+        "kv_cache_bytes": kv,
+        "logits_bytes": logits,
+        "total_bytes": total,
+        "batch": b,
+        "cache_len": cache_len,
+    }
+    if hbm_bytes is not None:
+        out["fits"] = total <= hbm_bytes
+    return out

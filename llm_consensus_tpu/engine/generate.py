@@ -110,6 +110,50 @@ def generate(
             f"cache_len {cache_len} < prompt {s} + max_new_tokens {max_new_tokens}"
         )
 
+    logits, cache = _prefill_into_cache(
+        cfg, params, tokens, lengths,
+        cache_len=cache_len,
+        shared_prefill=shared_prefill,
+        kv_quant=kv_quant,
+        mesh=mesh,
+        prefill_chunk=prefill_chunk,
+    )
+
+    return _decode_loop(
+        cfg,
+        params,
+        logits,
+        cache,
+        key,
+        temperature,
+        sampler=sampler,
+        eos_id=eos_id,
+        pad_id=pad_id,
+        max_new_tokens=max_new_tokens,
+        uniform_write=shared_prefill,
+        stop_ids=stop_ids,
+    )
+
+
+def _prefill_into_cache(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    cache_len: int,
+    shared_prefill: bool = False,
+    kv_quant: bool = False,
+    mesh=None,
+    prefill_chunk: int = 0,
+):
+    """The prefill half of :func:`generate`: allocate the cache, fill it,
+    return (first-token logits [B, V], cache at B rows).
+
+    Shared between :func:`generate`'s one-shot program and the engine's
+    chunked-decode path (multi-token stop sequences need host checks
+    between device calls, so prefill and decode must be separable)."""
+    b = tokens.shape[0]
     make_cache = QuantKVCache.create if kv_quant else KVCache.create
 
     def _prefill(p_tokens, p_lengths, p_cache):
@@ -142,21 +186,20 @@ def generate(
     else:
         cache = make_cache(cfg, b, cache_len)
         logits, cache = _prefill(tokens, lengths, cache)
+    return logits, cache
 
-    return _decode_loop(
-        cfg,
-        params,
-        logits,
-        cache,
-        key,
-        temperature,
-        sampler=sampler,
-        eos_id=eos_id,
-        pad_id=pad_id,
-        max_new_tokens=max_new_tokens,
-        uniform_write=shared_prefill,
-        stop_ids=stop_ids,
-    )
+
+prefill_into_cache = partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "cache_len",
+        "shared_prefill",
+        "kv_quant",
+        "mesh",
+        "prefill_chunk",
+    ),
+)(_prefill_into_cache)
 
 
 def _terminal_matcher(eos_id: int, stop_ids: tuple[int, ...]):
@@ -254,6 +297,7 @@ def _decode_loop(
         "cache_len",
         "stop_ids",
         "shared_suffix",
+        "kv_quant",
     ),
 )
 def generate_from_prefix(
@@ -274,6 +318,7 @@ def generate_from_prefix(
     cache_len: int | None = None,
     stop_ids: tuple[int, ...] = (),
     shared_suffix: bool = False,
+    kv_quant: bool = False,
 ) -> GenerateOutput:
     """Generate continuing from a prefilled shared prompt prefix.
 
@@ -304,11 +349,18 @@ def generate_from_prefix(
     writes, the same convention as prefill padding.
 
     Exactness-tested against :func:`generate` on the concatenated
-    prompts. bf16 cache only (the quant cache's head-major layout has no
-    chunk path); single device / data-replicated params.
-    """
-    from llm_consensus_tpu.models.transformer import _chunk_hidden, _unembed
+    prompts (bf16 cache; the ``kv_quant`` path matches to within int8 KV
+    rounding — the same rounding the plain quant path pays). On a mesh
+    the batch axes shard over ``data`` by GSPMD propagation from the
+    engine-placed inputs; the B=1 prefix replicates and broadcasts into
+    the sharded cache.
 
+    ``kv_quant`` (static): continue into an int8 head-major
+    :class:`~llm_consensus_tpu.models.cache.QuantKVCache` — the stored
+    bf16 prefix K/V is quantized on entry with the SAME per-(token,
+    head) rule prefill itself uses, so the cache holds identical int8
+    values to a from-scratch quant prefill of the prefix.
+    """
     b, s = tokens.shape
     p = prefix_k.shape[2]  # bucket width Pb >= real prefix_len
     if cache_len is None:
@@ -319,32 +371,12 @@ def generate_from_prefix(
             f"+ max_new_tokens {max_new_tokens}"
         )
 
-    # shared_suffix (static): all B rows carry the SAME suffix (N-way
-    # self-consistency fan-out) — run the suffix chunk once at B=1 and
-    # broadcast, like generate()'s shared_prefill.
-    cb = 1 if shared_suffix else b
-    cache = KVCache.create(cfg, cb, cache_len, dtype=prefix_k.dtype)
-    kb = jnp.broadcast_to(prefix_k, (prefix_k.shape[0], cb, *prefix_k.shape[2:]))
-    vb = jnp.broadcast_to(prefix_v, (prefix_v.shape[0], cb, *prefix_v.shape[2:]))
-    plen = jnp.asarray(prefix_len, jnp.int32)
-    cache = KVCache(
-        k=jax.lax.dynamic_update_slice(cache.k, kb, (0, 0, 0, 0, 0)),
-        v=jax.lax.dynamic_update_slice(cache.v, vb, (0, 0, 0, 0, 0)),
-        length=jnp.full((cb,), 1, jnp.int32) * plen,
+    logits, cache = _prefix_prefill_impl(
+        cfg, params, prefix_k, prefix_v, prefix_len, tokens, lengths,
+        cache_len=cache_len,
+        shared_suffix=shared_suffix,
+        kv_quant=kv_quant,
     )
-
-    hidden, cache = _chunk_hidden(cfg, params, tokens[:cb], cache)
-    last = jnp.clip(lengths[:cb] - 1, 0, s - 1)
-    x_last = hidden[jnp.arange(cb), last]  # [cb, D]
-    logits = _unembed(cfg, params, x_last)
-    if shared_suffix:
-        logits = jnp.broadcast_to(logits, (b, logits.shape[-1]))
-        cache = _broadcast_cache(cache, b).with_length(plen + lengths)
-    else:
-        # Suffix padding slots hold garbage k/v past each row's true
-        # length — masked out of decode attention and progressively
-        # overwritten, the same convention as prefill padding.
-        cache = cache.with_length(plen + lengths)
 
     return _decode_loop(
         cfg,
@@ -362,6 +394,91 @@ def generate_from_prefix(
         uniform_write=shared_suffix,
         stop_ids=stop_ids,
     )
+
+
+def _prefix_prefill_impl(
+    cfg: ModelConfig,
+    params: dict,
+    prefix_k: jnp.ndarray,
+    prefix_v: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    cache_len: int,
+    shared_suffix: bool = False,
+    kv_quant: bool = False,
+):
+    """Steps 1-2 of :func:`generate_from_prefix` (copy prefix K/V into a
+    fresh cache, run the suffix chunk): returns (first-token logits
+    [B, V], cache at B rows). Shared with the engine's chunked-decode
+    path so multi-token stop sequences get host checks on the
+    prefix-cached workload too."""
+    from llm_consensus_tpu.models.cache import quantize_kv
+    from llm_consensus_tpu.models.transformer import _chunk_hidden, _unembed
+
+    b, s = tokens.shape
+    # shared_suffix (static): all B rows carry the SAME suffix (N-way
+    # self-consistency fan-out) — run the suffix chunk once at B=1 and
+    # broadcast, like generate()'s shared_prefill.
+    cb = 1 if shared_suffix else b
+    plen = jnp.asarray(prefix_len, jnp.int32)
+    if kv_quant:
+        qcache = QuantKVCache.create(cfg, cb, cache_len)
+        kq, ks = quantize_kv(prefix_k)  # [L,1,P,H,D] / [L,1,P,H]
+        vq, vs = quantize_kv(prefix_v)
+        # Sequence-major -> the quant cache's head-major layout.
+        kq, vq = kq.transpose(0, 1, 3, 2, 4), vq.transpose(0, 1, 3, 2, 4)
+        ks, vs = ks.transpose(0, 1, 3, 2), vs.transpose(0, 1, 3, 2)
+
+        def bc(x):
+            return jnp.broadcast_to(x, (x.shape[0], cb, *x.shape[2:]))
+
+        z5 = (0, 0, 0, 0, 0)
+        cache = QuantKVCache(
+            k_q=jax.lax.dynamic_update_slice(qcache.k_q, bc(kq), z5),
+            v_q=jax.lax.dynamic_update_slice(qcache.v_q, bc(vq), z5),
+            k_scale=jax.lax.dynamic_update_slice(
+                qcache.k_scale, bc(ks), (0, 0, 0, 0)
+            ),
+            v_scale=jax.lax.dynamic_update_slice(
+                qcache.v_scale, bc(vs), (0, 0, 0, 0)
+            ),
+            length=jnp.full((cb,), 1, jnp.int32) * plen,
+        )
+    else:
+        cache = KVCache.create(cfg, cb, cache_len, dtype=prefix_k.dtype)
+        kb = jnp.broadcast_to(
+            prefix_k, (prefix_k.shape[0], cb, *prefix_k.shape[2:])
+        )
+        vb = jnp.broadcast_to(
+            prefix_v, (prefix_v.shape[0], cb, *prefix_v.shape[2:])
+        )
+        cache = KVCache(
+            k=jax.lax.dynamic_update_slice(cache.k, kb, (0, 0, 0, 0, 0)),
+            v=jax.lax.dynamic_update_slice(cache.v, vb, (0, 0, 0, 0, 0)),
+            length=jnp.full((cb,), 1, jnp.int32) * plen,
+        )
+
+    hidden, cache = _chunk_hidden(cfg, params, tokens[:cb], cache)
+    last = jnp.clip(lengths[:cb] - 1, 0, s - 1)
+    x_last = hidden[jnp.arange(cb), last]  # [cb, D]
+    logits = _unembed(cfg, params, x_last)
+    if shared_suffix:
+        logits = jnp.broadcast_to(logits, (b, logits.shape[-1]))
+        cache = _broadcast_cache(cache, b).with_length(plen + lengths)
+    else:
+        # Suffix padding slots hold garbage k/v past each row's true
+        # length — masked out of decode attention and progressively
+        # overwritten, the same convention as prefill padding.
+        cache = cache.with_length(plen + lengths)
+    return logits, cache
+
+
+prefill_from_prefix = partial(
+    jax.jit,
+    static_argnames=("cfg", "cache_len", "shared_suffix", "kv_quant"),
+)(_prefix_prefill_impl)
 
 
 @partial(
@@ -397,28 +514,28 @@ def decode_steps(
     Returns (tokens [B, steps] — pad after termination, live [B, steps]
     — True where the row was still generating when the slot was emitted
     (distinguishes post-termination padding from a genuinely sampled
-    pad id), new_cache, new_done, new_tok, logprob_sum [B] for the
-    chunk).
+    pad id), new_cache, new_done, new_tok, logprobs [B, steps] — the
+    PER-STEP sampled-token logprobs, zero where the row was already
+    done; callers that consume only a k-step prefix of the chunk sum
+    ``lps[:, :k]`` so tail-chunk overshoot never leaks into accounting).
     """
     _is_terminal = _terminal_matcher(eos_id, stop_ids)
 
     def step(carry, i):
-        tok, cache, done, lp = carry
+        tok, cache, done = carry
         logits, cache = decode_step(cfg, params, tok[:, None], cache)
         step_key = jax.random.fold_in(key, i)
         nxt, lp_i = sample_token(logits, step_key, temperature, sampler)
         nxt = jnp.where(done, pad_id, nxt)
-        lp = lp + jnp.where(done, 0.0, lp_i)
+        lp_i = jnp.where(done, 0.0, lp_i)
         next_done = done | _is_terminal(nxt)
-        return (nxt, cache, next_done, lp), (nxt, done)
+        return (nxt, cache, next_done), (nxt, done, lp_i)
 
-    b = tok.shape[0]
-    lp0 = jnp.zeros((b,), jnp.float32)
-    (tok_n, cache, done_n, lp), (toks, dones) = jax.lax.scan(
-        step, (tok, cache, done, lp0), jnp.arange(steps)
+    (tok_n, cache, done_n), (toks, dones, lps) = jax.lax.scan(
+        step, (tok, cache, done), jnp.arange(steps)
     )
     out = jnp.where(dones.T, pad_id, toks.T)  # [B, steps]
-    return out, ~dones.T, cache, done_n, tok_n, lp
+    return out, ~dones.T, cache, done_n, tok_n, lps.T
 
 
 @partial(

@@ -86,5 +86,9 @@ class PrefixCache:
             self.stats.evictions += 1
 
     def clear(self) -> None:
+        # Dropped entries count as evictions so stats stay consistent
+        # with observable cache history (hit_rate/evictions after a
+        # clear must reflect that entries were freed, not lost).
+        self.stats.evictions += len(self._entries)
         self._entries.clear()
         self._bytes = 0

@@ -109,3 +109,68 @@ def sample_token_per_row(
     return jax.vmap(one)(
         logits, keys, jnp.asarray(temperature, jnp.float32)
     )
+
+
+def sample_token_per_request(
+    logits: jnp.ndarray,
+    keys: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    filters_active: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`sample_token` with per-row keys AND per-row top_k/top_p.
+
+    The continuous batcher's sampler: every slot belongs to a different
+    request, so ALL sampler settings ride as data ([B] arrays) and the
+    decode-step program never recompiles when a request with new
+    settings joins the batch. Matches :func:`sample_token`'s filter and
+    logprob semantics row-for-row (logprob is pre-filtering,
+    temperature-scaled).
+
+    ``filters_active`` (static): False compiles the filters away
+    entirely — the caller knows from its host-side arrays that every
+    row has top_k=0 and top_p=1.0 (the common all-defaults workload),
+    so the two full-vocab sorts never run. When True, ONE descending
+    sort is shared by both filters."""
+    b = logits.shape[0]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+    if filters_active:
+        k = jnp.asarray(top_k, jnp.int32)
+        p = jnp.asarray(top_p, jnp.float32)
+        v = scaled.shape[-1]
+        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+        # top-k threshold from the shared sort.
+        k_eff = jnp.where(k > 0, jnp.clip(k, 1, v), v)
+        kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+        filtered = jnp.where(scaled < kth, _NEG_INF, scaled)
+        # Nucleus over the top-k-MASKED distribution (sequential
+        # semantics, matching _apply_top_p(_apply_top_k(...))): mask by
+        # VALUE, not position — the sequential top-k keeps every token
+        # TIED at the kth logit, so the nucleus set must include the
+        # ties too. The value mask is still a prefix of the descending
+        # sort, so one sort serves both filters.
+        in_k = sorted_desc >= kth
+        sorted_k = jnp.where(in_k, sorted_desc, _NEG_INF)
+        sorted_probs = jax.nn.softmax(sorted_k, axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        keep_sorted = ((cum - sorted_probs) < p[:, None]) & in_k
+        min_kept = jnp.min(
+            jnp.where(keep_sorted, sorted_k, jnp.inf),
+            axis=-1,
+            keepdims=True,
+        )
+        nucleus = jnp.where(filtered < min_kept, _NEG_INF, filtered)
+        filtered = jnp.where(p[:, None] >= 1.0, filtered, nucleus)
+    else:
+        filtered = scaled
+    sampled = jax.vmap(
+        lambda lg, kk: jax.random.categorical(kk, lg)
+    )(filtered, keys).astype(jnp.int32)
+    tok = jnp.where(temperature > 0, sampled, greedy_tok)
+    logprobs_full = jax.nn.log_softmax(scaled, axis=-1)
+    return tok, logprobs_full[jnp.arange(b), tok]

@@ -1,0 +1,103 @@
+"""Arithmetic SFT corpus for the end-to-end accuracy loop.
+
+The reference's compute layer is a production remote LLM
+(``src/main.rs:82-86``), so its consensus loop answered questions from
+day one. This environment is zero-egress — no pretrained checkpoint can
+be downloaded — so the framework proves the same property the honest
+way: TRAIN a small model on the synthetic arithmetic task distribution
+(:func:`llm_consensus_tpu.eval.gsm8k.synthetic_problems`), checkpoint
+it, reload it through :class:`InferenceEngine`, and measure real
+engine-backed EM-vs-N (``examples/train_arith_em.py``).
+
+The task: "((a + b) * c" word problems, a,b in [2,60], c in [2,9] —
+27,848 distinct triples. Training renders each triple as the EXACT
+prompt the eval harness uses plus a chain-of-thought completion::
+
+    <prompt from gsm8k._PROMPT> {a} + {b} = {s}. {s} * {c} = {x}. #### {x}<eos>
+
+Held-out split is at the TRIPLE level: every (a, b, c) appearing in the
+eval problem set is excluded from training, so EM measures
+generalization to unseen operand combinations, not memorization of the
+eval items.
+"""
+
+from __future__ import annotations
+
+import re
+
+from llm_consensus_tpu.eval.gsm8k import _PROMPT, Problem, synthetic_problems
+
+_INT_RE = re.compile(r"\d+")
+
+
+def problem_triple(p: Problem) -> tuple[int, int, int]:
+    """Recover (a, b, c) from a synthetic problem's question text."""
+    nums = _INT_RE.findall(p.question)
+    if len(nums) < 3:
+        raise ValueError(f"not a synthetic arithmetic question: {p.question!r}")
+    return int(nums[0]), int(nums[1]), int(nums[2])
+
+
+def all_triples() -> list[tuple[int, int, int]]:
+    """Every (a, b, c) the synthetic generator can draw (27,848)."""
+    return [
+        (a, b, c)
+        for a in range(2, 61)
+        for b in range(2, 61)
+        for c in range(2, 10)
+    ]
+
+
+def render_example(a: int, b: int, c: int) -> tuple[str, str]:
+    """(prompt, completion) text for one triple.
+
+    The prompt is byte-identical to what ``evaluate_self_consistency``
+    sends (same ``_PROMPT`` template, same question wording as
+    ``synthetic_problems``); the completion is a two-step
+    chain-of-thought ending in the ``#### <answer>`` marker the EM
+    extractor keys on.
+    """
+    s, x = a + b, (a + b) * c
+    q = (
+        f"A basket holds {a} apples. {b} more are added, then the "
+        f"total is multiplied by {c} for a festival order. "
+        f"How many apples are in the order?"
+    )
+    prompt = _PROMPT.format(q=q)
+    completion = f" {a} + {b} = {s}. {s} * {c} = {x}. #### {x}"
+    return prompt, completion
+
+
+def build_sft_examples(
+    tokenizer,
+    *,
+    exclude: set[tuple[int, int, int]] | None = None,
+    limit: int | None = None,
+) -> list[tuple[list[int], list[int]]]:
+    """Tokenized (prompt_ids, completion_ids) pairs for SFT.
+
+    ``exclude``: triples to hold out (the eval set's). The completion
+    carries a trailing EOS so a trained model terminates its answers.
+    """
+    exclude = exclude or set()
+    out = []
+    for t in all_triples():
+        if t in exclude:
+            continue
+        prompt, completion = render_example(*t)
+        p_ids = tokenizer.encode(prompt)
+        c_ids = tokenizer.encode(completion, add_bos=False) + [
+            tokenizer.eos_id
+        ]
+        out.append((p_ids, c_ids))
+        if limit and len(out) >= limit:
+            break
+    return out
+
+
+def eval_split(
+    n_eval: int, seed: int = 0
+) -> tuple[list[Problem], set[tuple[int, int, int]]]:
+    """The eval problems and their triples (the training holdout set)."""
+    problems = synthetic_problems(n_eval, seed=seed)
+    return problems, {problem_triple(p) for p in problems}

@@ -140,6 +140,20 @@ PRESETS: dict[str, ModelConfig] = {
         rope_theta=10000.0,
         max_seq_len=4096,
     ),
+    # ~14M byte-level model for the end-to-end accuracy loop
+    # (examples/train_arith_em.py): small enough to train to high EM on
+    # the synthetic arithmetic task in minutes on one chip, big enough
+    # to actually learn two-step chain-of-thought arithmetic.
+    "arith-14m": ModelConfig(
+        name="arith-14m",
+        vocab_size=384,
+        d_model=384,
+        n_layers=6,
+        n_heads=6,
+        n_kv_heads=6,
+        d_ff=1536,
+        max_seq_len=512,
+    ),
     # Tiny configs for tests (CPU-simulated meshes). vocab 384 >= the
     # ByteTokenizer's 259 ids so end-to-end text tests can run on them.
     "test-tiny": ModelConfig(

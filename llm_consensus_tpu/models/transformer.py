@@ -227,6 +227,35 @@ def param_count(params) -> int:
     return sum(p.size for p in jax.tree_util.tree_leaves(params))
 
 
+def init_params_quantized(
+    cfg: ModelConfig,
+    key: jax.Array,
+    *,
+    bits: int = 8,
+    dtype=jnp.bfloat16,
+    device=None,
+) -> dict:
+    """Init on the host CPU, quantize there, then transfer to ``device``.
+
+    Peak device HBM is the *quantized* footprint, never the bf16 one.
+    ``init_params`` + ``quantize_params`` on-device would hold both copies
+    at once (~24 GB for Llama-3-8B int8) and OOM a 16 GB v5e chip; this
+    path stages through host RAM so the chip only ever sees int8/int4
+    leaves (~8.6 GB for 8B int8).
+    """
+    from llm_consensus_tpu.ops.quant import quantize_params
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        params = init_params(cfg, key, dtype=dtype)
+        params = quantize_params(params, bits=bits)
+        # Materialize on CPU before transfer so the donor buffers free.
+        params = jax.tree_util.tree_map(lambda x: x.block_until_ready(), params)
+    if device is not None:
+        params = jax.device_put(params, device)
+    return params
+
+
 # ---------------------------------------------------------------------------
 # Blocks
 # ---------------------------------------------------------------------------
@@ -421,23 +450,52 @@ def _block(
                 vs_l.at[:, :, :s].set(vs.transpose(0, 2, 1)),
             )
     elif mode == "chunk":
-        # K-token speculative-verification chunk: write all K tokens'
-        # k/v at slots [valid_len, valid_len + K) (ragged per row), then
-        # ragged-causal attention over the cache. bf16 cache only (the
-        # int8 head-major scatter layout isn't worth the complexity on
-        # the verification path).
-        if len(kv_layer) != 2:
-            raise ValueError("chunk decode requires the bf16 KV cache")
+        # K-token chunk (speculative verification / prefix-cached
+        # continuation): write all K tokens' k/v at slots
+        # [valid_len, valid_len + K) (ragged per row), then ragged-causal
+        # attention over the cache.
         b, kq = x.shape[0], x.shape[1]
-        k_l, v_l = kv_layer
         batch_idx = jnp.arange(b)[:, None]  # [B, 1]
         pos = valid_len[:, None] + jnp.arange(kq)[None, :]  # [B, K]
-        new_k = k_l.at[batch_idx, pos].set(k.astype(k_l.dtype))
-        new_v = v_l.at[batch_idx, pos].set(v.astype(v_l.dtype))
-        new_kv = (new_k, new_v)
-        attn = chunk_decode_attention(
-            q, new_k, new_v, valid_len, window=cfg.sliding_window
-        )
+        if len(kv_layer) == 2:
+            k_l, v_l = kv_layer
+            new_k = k_l.at[batch_idx, pos].set(k.astype(k_l.dtype))
+            new_v = v_l.at[batch_idx, pos].set(v.astype(v_l.dtype))
+            new_kv = (new_k, new_v)
+            attn = chunk_decode_attention(
+                q, new_k, new_v, valid_len, window=cfg.sliding_window
+            )
+        else:
+            # int8 head-major cache (prefix-cached generation on
+            # kv_quant engines). The chunk path is prefill-like, not
+            # the decode hot loop: quantized writes keep the cache
+            # layout canonical; attention reads a dequantized slab
+            # (bf16) through the same ragged-causal rule — exactness
+            # vs the bf16 path bounded only by int8 KV rounding.
+            kq_l, vq_l, ks_l, vs_l = kv_layer
+            kqn, ksn = quantize_kv(k)  # [B,K,Hkv,D] / [B,K,Hkv]
+            vqn, vsn = quantize_kv(v)
+            hidx = jnp.arange(kq_l.shape[1])[None, :, None]  # [1,Hkv,1]
+            pos_h = pos[:, None, :]  # [B,1,K]
+            bidx_h = batch_idx[:, :, None]  # [B,1,1]
+            new_kq = kq_l.at[bidx_h, hidx, pos_h].set(kqn.transpose(0, 2, 1, 3))
+            new_vq = vq_l.at[bidx_h, hidx, pos_h].set(vqn.transpose(0, 2, 1, 3))
+            new_ks = ks_l.at[bidx_h, hidx, pos_h].set(ksn.transpose(0, 2, 1))
+            new_vs = vs_l.at[bidx_h, hidx, pos_h].set(vsn.transpose(0, 2, 1))
+            new_kv = (new_kq, new_vq, new_ks, new_vs)
+            deq_k = (
+                (new_kq.astype(jnp.float32) * new_ks[..., None])
+                .astype(q.dtype)
+                .transpose(0, 2, 1, 3)  # -> [B, S, Hkv, D]
+            )
+            deq_v = (
+                (new_vq.astype(jnp.float32) * new_vs[..., None])
+                .astype(q.dtype)
+                .transpose(0, 2, 1, 3)
+            )
+            attn = chunk_decode_attention(
+                q, deq_k, deq_v, valid_len, window=cfg.sliding_window
+            )
     elif mode == "decode":
         b = x.shape[0]
         batch_idx = jnp.arange(b)

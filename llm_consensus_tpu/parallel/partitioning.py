@@ -111,3 +111,33 @@ def shard_params(params, mesh: Mesh):
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
+
+
+def sharded_param_bytes(tree, mesh_shape: dict) -> int:
+    """Per-chip resident bytes of a param tree under this module's rules.
+
+    Walks :func:`param_pspecs` leaf-for-leaf and divides each leaf's
+    bytes by the product of the mesh-axis sizes its spec actually names
+    — NOT a global model*expert divide, which would pretend replicated
+    leaves (embeddings, norms, and on MoE models ALL attention weights,
+    which replicate over ``expert``) shard too and understate per-chip
+    residency. Accepts concrete arrays or ``jax.eval_shape`` structs
+    (capacity planning without allocation).
+    """
+    specs = param_pspecs(tree)
+
+    def leaf_bytes(leaf, spec) -> int:
+        div = 1
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is not None:
+                    div *= int(mesh_shape.get(ax, 1))
+        return leaf.size * leaf.dtype.itemsize // max(div, 1)
+
+    return sum(
+        leaf_bytes(leaf, spec)
+        for leaf, spec in zip(
+            jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(specs)
+        )
+    )

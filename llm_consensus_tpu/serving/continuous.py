@@ -42,10 +42,10 @@ from llm_consensus_tpu.backends import base as _backend_base
 from llm_consensus_tpu.engine.engine import _next_bucket
 from llm_consensus_tpu.engine.sampler import (
     SamplerConfig,
-    sample_token,
-    sample_token_per_row,
+    sample_token_per_request,
 )
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
+from llm_consensus_tpu.utils.stops import earliest_stop_cut, stop_tail_window
 from llm_consensus_tpu.models.cache import KVCache
 from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.models.paged_cache import (
@@ -90,6 +90,20 @@ class _Request:
     temperature: float
     seed: int
     future: Future
+    # Per-request sampler settings ride as decode-step DATA (arrays),
+    # never as compiled constants — a request with new settings joining
+    # the batch must not recompile the hot loop.
+    top_k: int = 0
+    top_p: float = 1.0
+    # Stop sequences (engine contract): text trims at the earliest
+    # occurrence; the host loop sees every sampled token, so multi-token
+    # stops end decoding immediately (no overshoot to EOS/length).
+    stop: tuple[str, ...] = ()
+    # Tail-window width for the per-token stop check, precomputed once
+    # at submit (stop strings are immutable for the request's life —
+    # re-encoding them per sampled token would put tokenizer calls on
+    # the thread pacing device steps).
+    stop_window: int = 0
 
 
 @dataclass
@@ -127,6 +141,10 @@ class ContinuousBatcher:
         # index), so sampling is reproducible regardless of batch-mates.
         self._seeds = np.zeros((c.max_slots,), np.int32)
         self._counts = np.zeros((c.max_slots,), np.int32)
+        # Per-slot sampler settings (data, not compiled constants).
+        dflt = c.sampler or SamplerConfig()
+        self._topks = np.full((c.max_slots,), dflt.top_k, np.int32)
+        self._topps = np.full((c.max_slots,), dflt.top_p, np.float32)
         self._completed = 0
         self._generated_tokens = 0
         self._decode_steps = 0
@@ -135,7 +153,9 @@ class ContinuousBatcher:
         self._work = threading.Event()
         # params ride as a jit argument (not a closure constant) so the
         # weights aren't baked into the executable.
-        self._jit_decode = jax.jit(self._decode_sample, donate_argnums=(1,))
+        self._jit_decode = jax.jit(
+            self._decode_sample, donate_argnums=(1,), static_argnums=(8,)
+        )
         self._jit_prefill = {}
         self._thread = threading.Thread(
             target=self._run, name="continuous-batcher", daemon=True
@@ -144,15 +164,31 @@ class ContinuousBatcher:
 
     # -- device programs ------------------------------------------------
 
-    def _decode_sample(self, params, cache, tokens, seeds, counts, temps):
+    def _decode_sample(
+        self,
+        params,
+        cache,
+        tokens,
+        seeds,
+        counts,
+        temps,
+        topks,
+        topps,
+        filters_active,
+    ):
         logits, cache = decode_step_paged(
             self.cfg, params, tokens[:, None], cache
         )
-        sampler = self.config.sampler or SamplerConfig()
         keys = jax.vmap(
             lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
         )(seeds, counts)
-        next_tok, logp = sample_token_per_row(logits, keys, temps, sampler)
+        # filters_active is STATIC (two cached programs): the
+        # all-defaults workload — every active request with top_k=0,
+        # top_p=1.0 — never pays the filters' full-vocab sort.
+        next_tok, logp = sample_token_per_request(
+            logits, keys, temps, topks, topps,
+            filters_active=filters_active,
+        )
         return next_tok, logp, cache
 
     def _prefill_fn(self, s_bucket: int):
@@ -181,8 +217,22 @@ class ContinuousBatcher:
         max_new_tokens: int | None = None,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        stop: list[str] | tuple[str, ...] | None = None,
     ) -> Future:
-        """Enqueue a request; Future resolves to a :class:`ServeResult`."""
+        """Enqueue a request; Future resolves to a :class:`ServeResult`.
+
+        ``top_k``/``top_p``: ``None`` inherits the batcher's
+        config-level sampler; any EXPLICIT value is authoritative —
+        including 0 / 1.0, which mean *disabled* exactly as in
+        ``SamplingParams`` (so a protocol request with default params
+        samples unfiltered on this backend just like on LocalBackend,
+        and "no top_k" is expressible on a batcher configured with
+        one). ``stop`` follows the engine's stop-sequence contract —
+        text trimmed at the earliest stop (stop removed), and the row
+        retires as soon as the stop appears (every token is
+        host-checked, so multi-token stops end decoding immediately)."""
         if self._stop.is_set():
             raise RuntimeError("batcher stopped")
         c = self.config
@@ -205,12 +255,19 @@ class ContinuousBatcher:
                 cap,
             )
         ids = np.asarray(full_ids[-cap:], np.int32)
+        dflt = c.sampler or SamplerConfig()
+        stop = tuple(stop or ())
+        window = stop_tail_window(self.tokenizer, stop)
         req = _Request(
             prompt_ids=ids,
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             seed=seed,
             future=Future(),
+            top_k=dflt.top_k if top_k is None else top_k,
+            top_p=dflt.top_p if top_p is None else top_p,
+            stop=stop,
+            stop_window=window,
         )
         with self._lock:
             self._waiting.append(req)
@@ -304,11 +361,13 @@ class ContinuousBatcher:
             )
             # First sampled token comes from the prefill logits.
             key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
-            tok, _ = sample_token(
+            tok, _ = sample_token_per_request(
                 logits[None],
-                key,
+                key[None],
                 jnp.asarray([req.temperature], jnp.float32),
-                self.config.sampler or SamplerConfig(),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32),
+                filters_active=(req.top_k != 0 or req.top_p != 1.0),
             )
             first = int(tok[0])
             slot = _Slot(
@@ -322,8 +381,41 @@ class ContinuousBatcher:
             self._last_tokens[free_slot] = first
             self._seeds[free_slot] = req.seed
             self._counts[free_slot] = 1  # token 0 sampled from prefill
-            if first == self.tokenizer.eos_id or req.max_new_tokens <= 1:
+            self._topks[free_slot] = req.top_k
+            self._topps[free_slot] = req.top_p
+            if (
+                first == self.tokenizer.eos_id
+                or req.max_new_tokens <= 1
+                or self._hit_stop(slot)
+            ):
                 self._retire(free_slot)
+
+    def _decoded_text(self, slot: _Slot) -> str:
+        ids = [t for t in slot.generated if t != self.tokenizer.eos_id]
+        return self.tokenizer.decode(ids)
+
+    def _hit_stop(self, slot: _Slot) -> bool:
+        """True when any stop sequence appears in the decoded text so
+        far. Host-checked after EVERY sampled token — multi-token stops
+        terminate immediately, with no overshoot to EOS/length (the
+        engine's batch path can only device-stop single-token stops).
+
+        Only a TAIL WINDOW of tokens is decoded per check — the longest
+        stop's token length plus slack for a stop/multibyte sequence
+        straddling the window head — so per-request stop checking stays
+        O(T·window), not O(T²), on the thread that paces device steps.
+        """
+        stops = slot.request.stop
+        if not stops:
+            return False
+        w = slot.request.stop_window
+        ids = [
+            t
+            for t in slot.generated[-2 * w :]
+            if t != self.tokenizer.eos_id
+        ][-w:]
+        text = self.tokenizer.decode(ids)
+        return any(s in text for s in stops)
 
     def _retire(self, idx: int) -> None:
         slot = self._slots[idx]
@@ -334,13 +426,17 @@ class ContinuousBatcher:
             self._slots[idx] = None
             self._completed += 1
             self._generated_tokens += len(slot.generated)
-        ids = [
-            t for t in slot.generated if t != self.tokenizer.eos_id
-        ]
+        text = self._decoded_text(slot)
+        # Engine stop contract: trim at the earliest occurrence of any
+        # stop, removing the stop itself. num_tokens keeps the honest
+        # decoded count (here at most the stop's own tokens past the cut).
+        cut = earliest_stop_cut(text, slot.request.stop)
+        if cut >= 0:
+            text = text[:cut]
         if not slot.request.future.done():
             slot.request.future.set_result(
                 ServeResult(
-                    text=self.tokenizer.decode(ids),
+                    text=text,
                     num_tokens=len(slot.generated),
                 )
             )
@@ -351,6 +447,11 @@ class ContinuousBatcher:
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 temps[i] = slot.request.temperature
+        filters_active = any(
+            s is not None
+            and (s.request.top_k != 0 or s.request.top_p != 1.0)
+            for s in self._slots
+        )
         next_tok, _, self.cache = self._jit_decode(
             self.params,
             self.cache,
@@ -358,6 +459,9 @@ class ContinuousBatcher:
             jnp.asarray(self._seeds),
             jnp.asarray(self._counts),
             jnp.asarray(temps),
+            jnp.asarray(self._topks),
+            jnp.asarray(self._topps),
+            filters_active,
         )
         with self._lock:
             self._decode_steps += 1
@@ -372,6 +476,7 @@ class ContinuousBatcher:
             done = (
                 tok == self.tokenizer.eos_id
                 or len(slot.generated) >= slot.request.max_new_tokens
+                or self._hit_stop(slot)
             )
             if done:
                 self._retire(i)
@@ -405,15 +510,10 @@ class ContinuousBackend(_backend_base.Backend):
         BackendError = _backend_base.BackendError
         GenerationResult = _backend_base.GenerationResult
 
-        # Validate the WHOLE batch before submitting anything: raising
-        # mid-loop would abandon already-enqueued requests (device steps
-        # burned for futures nobody collects).
-        for r in requests:
-            if r.params.top_k or r.params.top_p != 1.0:
-                raise BackendError(
-                    "ContinuousBatcher applies its config-level sampler; "
-                    "per-request top_k/top_p are not supported"
-                )
+        # Per-request top_k/top_p/stop ride as decode-step data
+        # (sample_token_per_request + host stop checks), so the full
+        # SamplingParams surface passes through — protocol-identical
+        # behavior to LocalBackend.
         futs = []
         try:
             for r in requests:
@@ -423,6 +523,9 @@ class ContinuousBackend(_backend_base.Backend):
                         max_new_tokens=r.params.max_new_tokens,
                         temperature=r.params.temperature,
                         seed=r.params.seed,
+                        top_k=r.params.top_k,
+                        top_p=r.params.top_p,
+                        stop=r.params.stop,
                     )
                 )
         except (RuntimeError, ValueError) as e:

@@ -21,6 +21,83 @@ def write_token_shard(path: str | os.PathLike, tokens: np.ndarray) -> None:
     np.ascontiguousarray(tokens, np.int32).tofile(path)
 
 
+class SftBatchLoader:
+    """Padded per-example batches with completion-only loss masks.
+
+    Supervised fine-tuning counterpart of :class:`TokenBatchLoader` for
+    (prompt, completion) pairs (the arithmetic accuracy loop,
+    ``examples/train_arith_em.py``): each ``next()`` draws a seeded
+    random batch of examples, right-pads to ``[batch, seq]`` with
+    ``pad_id``, and builds the loss mask so only *completion-token
+    predictions* count — ``mask[i] = 1`` exactly where ``tokens[i+1]``
+    is a completion token, matching ``causal_lm_loss``'s one-position
+    shift. Exposes the same ``position``/``seek`` resume contract as
+    :class:`TokenBatchLoader`.
+    """
+
+    def __init__(
+        self,
+        examples: list[tuple[list[int], list[int]]],
+        batch: int,
+        seq: int,
+        seed: int = 0,
+        pad_id: int = 0,
+    ):
+        self.batch, self.seq = batch, seq
+        self.pad_id = pad_id
+        self._seed = seed
+        self._drawn = 0
+        self._data: list[tuple[np.ndarray, int]] = []
+        for p, c in examples:
+            ids = np.asarray((list(p) + list(c))[:seq], np.int32)
+            if len(p) >= len(ids):
+                continue  # completion truncated away entirely: no signal
+            if len(ids) < 2:
+                continue  # a single token has no next-token target
+            self._data.append((ids, len(p)))
+        if not self._data:
+            raise ValueError("no example fits within seq")
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def n_examples(self) -> int:
+        return len(self._data)
+
+    @property
+    def position(self) -> int:
+        return self._drawn
+
+    def seek(self, position: int) -> None:
+        if position < self._drawn:
+            self._rng = np.random.default_rng(self._seed)
+            self._drawn = 0
+        while self._drawn < position:
+            self._rng.integers(0, len(self._data), size=self.batch)
+            self._drawn += 1
+
+    def next(self) -> tuple[np.ndarray, np.ndarray]:
+        idx = self._rng.integers(0, len(self._data), size=self.batch)
+        toks = np.full((self.batch, self.seq), self.pad_id, np.int32)
+        mask = np.zeros((self.batch, self.seq), np.float32)
+        for r, j in enumerate(idx):
+            ids, p = self._data[j]
+            toks[r, : len(ids)] = ids
+            # Predictions of tokens p..len-1 (the completion) live at
+            # predictor positions p-1..len-2. An empty prompt (p=0)
+            # clamps to 0: token 0 itself has no predictor, and the
+            # naive p-1 slice would wrap to seq-1 and zero the mask.
+            mask[r, max(p - 1, 0) : len(ids) - 1] = 1.0
+        self._drawn += 1
+        return toks, mask
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self) -> None:  # loader-protocol parity
+        pass
+
+
 class TokenBatchLoader:
     """Random contiguous [batch, seq] windows from a token shard.
 

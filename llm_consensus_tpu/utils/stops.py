@@ -1,0 +1,54 @@
+"""Stop-sequence rules shared by every surface that honors them.
+
+The stop contract (engine batch path, streaming, prefix-cached
+generation, and the continuous batcher all promise the same observable
+behavior — ``tests/test_paged.py::test_backend_stop_parity_local_vs_
+continuous`` asserts it across the Backend seam) lives HERE once:
+
+- :func:`earliest_stop_cut` — where to trim the final text (earliest
+  occurrence of any stop; the stop itself is removed by the caller).
+- :func:`stop_tail_window` — how many tail tokens a per-token host
+  check must decode to be able to see a stop that ends at the newest
+  token (longest stop's token length plus slack for a stop/multibyte
+  sequence straddling the window head).
+
+A precedence or slack change edited here propagates to every surface;
+duplicated inline copies would silently disagree.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def earliest_stop_cut(text: str, stops: Iterable[str]) -> int:
+    """Index of the earliest occurrence of any stop in ``text``; -1 if
+    none occurs. Ties across stops resolve to the smallest index."""
+    return min(
+        (i for s in stops if (i := text.find(s)) >= 0),
+        default=-1,
+    )
+
+
+def stop_tail_window(tokenizer, stops: Iterable[str], slack: int = 8) -> int:
+    """Tail-token window width for incremental stop checks.
+
+    The window must cover the WORST-CASE token count a model can spend
+    emitting the stop text — not the count the tokenizer's own greedy
+    encoding uses: a merge-based tokenizer may encode "\\n\\n---" as 2
+    ids, but a model can emit the same characters one fine-grained
+    token at a time. Every token decodes to at least one byte, so
+    ``len(stop.encode("utf-8"))`` bounds the span for any tokenizer;
+    the encoded length is kept as a floor for exotic multi-char-per-
+    byte cases, and ``slack`` covers a multibyte character (or another
+    stop's prefix) straddling the window head. Compute ONCE per
+    request/call — tokenizer encodes on the thread pacing device steps
+    are not free."""
+    stops = list(stops)
+    if not stops:
+        return 0
+    span = max(
+        max(len(s.encode("utf-8")), len(tokenizer.encode(s, add_bos=False)))
+        for s in stops
+    )
+    return span + slack

@@ -64,6 +64,10 @@ def pytest_collection_modifyitems(config, items):
     items.sort(
         key=lambda it: 0 if any(h in it.nodeid for h in heavy) else 1
     )
+    # Smoke-tier marking (see _SMOKE_TESTS at the bottom of this file).
+    for item in items:
+        if item.name.split("[")[0] in _SMOKE_TESTS:
+            item.add_marker(pytest.mark.smoke)
 
 
 @pytest.fixture(autouse=True, scope="module")
@@ -82,3 +86,50 @@ def _bounded_xla_arena():
 
     jax.clear_caches()
     yield
+
+
+# ---------------------------------------------------------------------------
+# Smoke tier: one-or-two fast tests per subsystem, selected centrally so
+# the list is auditable in one place. `pytest -m smoke` runs in <2 min
+# (gate iteration / future-round triage); the FULL suite stays the merge
+# gate. Names, not nodeids: parametrized variants all count.
+# ---------------------------------------------------------------------------
+
+_SMOKE_TESTS = {
+    # protocol: messages/parsing/prompts/personas/coordinator/debate
+    "test_good_verdict",
+    "test_answer_prompt_shape",
+    "test_default_panel_matches_reference",
+    "test_unanimous_first_round",
+    "test_debate_validates_before_generating",
+    "test_faults_are_seeded_and_counted",
+    # voting / eval
+    "test_majority_vote_basic",
+    "test_bundled_dataset_loads_and_golds_extract",
+    # ops / model / quant
+    "test_rms_norm_matches_numpy",
+    "test_forward_shapes_and_dtype",
+    "test_quantize_roundtrip_error_bound",
+    "test_quantize_kv_roundtrip",
+    # engine / tokenizer / backends
+    "test_byte_tokenizer_roundtrip",
+    "test_engine_text_roundtrip",
+    "test_generate_batch_returns_aligned_results",
+    # training / data / checkpoint
+    "test_sft_loader_mask_and_resume",
+    "test_loss_is_finite_and_near_uniform_at_init",
+    "test_params_roundtrip",
+    # parallel / multihost
+    "test_make_mesh_default_all_data",
+    "test_param_pspecs_cover_dense_and_moe",
+    "test_pp_param_pspecs_shard_layer_axis",
+    "test_initialize_noop_single_host",
+    # serving / paged
+    "test_page_write_gather_roundtrip",
+    "test_submit_after_close_raises",
+    # native runtime / utils / cli
+    "test_batch_encode_matches_python_tokenizer",
+    "test_tracer_spans_and_summary",
+    "test_parser_defaults",
+    "test_one_shot_question_fake_backend",
+}

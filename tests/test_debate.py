@@ -178,10 +178,13 @@ def test_debate_validates_before_generating():
         run_debate(ExplodingEngine(), "q", DebateConfig(method="rescore"))
 
     class MeshEngine(ExplodingEngine):
+        # Sharded engines are first-class for rescore now (score_texts
+        # shards completions over `data`): validation must PASS and the
+        # debate proceed to generation.
         mesh = object()
 
         def score_texts(self, *a, **k):
             raise AssertionError("must not score")
 
-    with pytest.raises(ValueError, match="score_texts and no"):
+    with pytest.raises(AssertionError, match="must not generate"):
         run_debate(MeshEngine(), "q", DebateConfig(method="rescore"))

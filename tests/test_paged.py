@@ -147,6 +147,48 @@ def test_continuous_matches_engine_greedy(batcher):
     assert got == want
 
 
+def test_backend_stop_parity_local_vs_continuous(batcher):
+    """Protocol matrix with stops: LocalBackend (engine path) and
+    ContinuousBackend must serve IDENTICAL text for the same greedy
+    requests carrying stop sequences — the seam contract the consensus
+    protocol relies on when swapping substrates."""
+    import asyncio
+
+    from llm_consensus_tpu.backends.base import (
+        GenerationRequest,
+        SamplingParams,
+    )
+    from llm_consensus_tpu.backends.local import LocalBackend
+    from llm_consensus_tpu.serving.continuous import ContinuousBackend
+
+    eng = InferenceEngine(
+        CFG,
+        _params(),
+        engine_config=EngineConfig(max_new_tokens=8, seq_buckets=(16, 32, 64)),
+    )
+    # Carve stops out of real greedy output so they actually trigger;
+    # keep only prompts whose output is long enough to carve from.
+    probe_prompts = ["hello world", "abc", "the quick", "zed"]
+    base = [
+        r.text
+        for r in eng.generate_texts(probe_prompts, max_new_tokens=8)
+    ]
+    usable = [(p, t) for p, t in zip(probe_prompts, base) if len(t) >= 4]
+    if not usable:
+        pytest.skip("all outputs too short to carve stops from")
+    reqs = [
+        GenerationRequest(
+            prompt=p,
+            params=SamplingParams(max_new_tokens=8, stop=(t[2:4],)),
+        )
+        for p, t in usable
+    ]
+    local = asyncio.run(LocalBackend(eng).generate_batch(reqs))
+    cont = asyncio.run(ContinuousBackend(batcher).generate_batch(reqs))
+    assert [r.text for r in local] == [r.text for r in cont]
+    assert all(s not in r.text for r, q in zip(local, reqs) for s in q.params.stop)
+
+
 def test_continuous_pool_exhaustion_recovers():
     """More requests than pool pages: later ones wait, all complete."""
     b = ContinuousBatcher(
@@ -276,27 +318,54 @@ def test_continuous_backend_generate_batch(batcher):
     assert all(r.num_tokens >= 1 for r in results)
 
 
-def test_continuous_backend_rejects_per_request_topk(batcher):
+def test_continuous_backend_per_request_sampling_passthrough(batcher):
+    """Per-request top_k/top_p ride as decode-step data now — a request
+    with its own sampler settings must serve (no recompile-guard
+    rejection), and top_k=1 must reduce to the greedy result."""
     import asyncio
 
     from llm_consensus_tpu.backends.base import (
-        BackendError,
         GenerationRequest,
         SamplingParams,
     )
     from llm_consensus_tpu.serving.continuous import ContinuousBackend
 
     backend = ContinuousBackend(batcher)
-    with pytest.raises(BackendError, match="top_k"):
-        asyncio.run(
-            backend.generate_batch(
-                [
-                    GenerationRequest(
-                        prompt="x", params=SamplingParams(top_k=5)
-                    )
-                ]
-            )
+    greedy, k1 = asyncio.run(
+        backend.generate_batch(
+            [
+                GenerationRequest(
+                    prompt="same prompt",
+                    params=SamplingParams(max_new_tokens=6),
+                ),
+                GenerationRequest(
+                    prompt="same prompt",
+                    params=SamplingParams(
+                        max_new_tokens=6, temperature=0.9, top_k=1, seed=5
+                    ),
+                ),
+            ]
         )
+    )
+    # top_k=1 sampling == greedy, regardless of temperature/seed.
+    assert k1.text == greedy.text
+
+
+def test_continuous_batcher_stop_sequences(batcher):
+    """The engine stop contract on the continuous batcher: text trims at
+    the earliest stop, and the row retires as soon as the stop appears
+    (multi-token stops end decoding immediately — every token is
+    host-checked)."""
+    full = batcher.submit("tell me a fact", max_new_tokens=8).result(60)
+    if len(full.text) < 4:
+        pytest.skip("output too short to carve a stop from")
+    stop = full.text[2:4]  # a MULTI-char stop that lands mid-output
+    r = batcher.submit(
+        "tell me a fact", max_new_tokens=8, stop=[stop]
+    ).result(60)
+    assert r.text == full.text[: full.text.find(stop)]
+    assert stop not in r.text
+    assert r.num_tokens <= full.num_tokens  # retired early, not trimmed late
 
 
 def test_coordinator_protocol_over_continuous_backend(batcher):

@@ -69,13 +69,22 @@ def test_decode_chunk_matches_sequential_decode_steps():
     assert cache2.length.tolist() == lengths.tolist()
 
 
-def test_decode_chunk_rejects_quant_cache():
+def test_decode_chunk_accepts_quant_cache():
+    """The chunk path now supports the int8 head-major cache (prefix
+    caching on kv_quant engines): logits come back finite and the
+    returned cache keeps the quant layout. Numerics bound vs bf16 lives
+    in test_engine.test_chunk_mode_quant_cache_close_to_bf16."""
     from llm_consensus_tpu.models.cache import QuantKVCache
 
     params = _params(0)
     cache = QuantKVCache.create(CFG, 1, 16)
-    with pytest.raises(ValueError, match="bf16"):
-        decode_chunk(CFG, params, jnp.ones((1, 2), jnp.int32), cache)
+    logits, new_cache = decode_chunk(
+        CFG, params, jnp.ones((1, 2), jnp.int32), cache
+    )
+    assert logits.shape == (1, 2, CFG.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+    assert isinstance(new_cache, QuantKVCache)
+    assert new_cache.k_q.dtype == jnp.int8
 
 
 # ---------------------------------------------------------------------------

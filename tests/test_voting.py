@@ -105,6 +105,25 @@ def test_device_majority_vote_matches_host():
     np.testing.assert_allclose(hist_w, [1, 2.5, 1, 3, 0])
 
 
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_device_majority_vote_caches_reducer():
+    """Repeat votes on the same (mesh, n_classes) must hit the jit cache:
+    the reducer is one cached jitted shard_map, not a fresh closure per
+    call (a fresh closure keys a new jit cache entry -> recompile)."""
+    from llm_consensus_tpu.consensus.voting import _vote_reducer
+
+    mesh = make_mesh(MeshConfig(data=8))
+    fn1 = _vote_reducer(mesh, 7, "data")
+    fn2 = _vote_reducer(mesh, 7, "data")
+    assert fn1 is fn2
+    ids = jnp.arange(8, dtype=jnp.int32) % 7
+    device_majority_vote(ids, n_classes=7, mesh=mesh)
+    n_compiled = fn1._cache_size()
+    device_majority_vote(ids, n_classes=7, mesh=mesh)
+    assert fn1._cache_size() == n_compiled  # second vote: no new compile
+    assert _vote_reducer(mesh, 5, "data") is not fn1  # distinct key
+
+
 def test_heterogeneous_panel_vote_weights_models():
     """config[3]: candidates from different models vote with their
     model's weight."""
