@@ -59,6 +59,24 @@ def main() -> int:
         action="store_true",
         help="skip the fused Pallas kernels (XLA-only decode path)",
     )
+    p.add_argument(
+        "--draft",
+        default="",
+        help="speculative-decoding bench: draft model preset (or 'self' "
+        "for the acceptance=1.0 overhead ceiling). Greedy, bf16 KV; "
+        "reports acceptance rate and tok/s vs the plain greedy path.",
+    )
+    p.add_argument("--k-spec", type=int, default=4)
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="continuous-batching serving bench: submit a burst of "
+        "requests through ContinuousBatcher (paged cache + paged "
+        "Pallas decode attention on TPU), report requests/sec and "
+        "generated tokens/sec",
+    )
+    p.add_argument("--serve-requests", type=int, default=64)
+    p.add_argument("--serve-slots", type=int, default=16)
     args = p.parse_args()
 
     if args.cpu:
@@ -130,11 +148,26 @@ def main() -> int:
     else:
         params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     b, s = args.n_candidates, args.prompt_len
-    tokens = jnp.ones((b, s), jnp.int32)
+    # Time-salted prompt + key: the tunnel runtime short-circuits repeat
+    # executions of a previously seen (executable, inputs) pair, even
+    # across processes — a re-run of an unchanged bench with fixed
+    # inputs would time the server's result cache, not the chip.
+    salt = int(time.time() * 1e6) % 29989
+    tokens = jnp.ones((b, s), jnp.int32).at[0, 0].set(1 + salt % 30000)
     lengths = jnp.full((b,), s, jnp.int32)
     temps = jnp.full((b,), 0.7, jnp.float32)
-    key = jax.random.PRNGKey(0)
+    key = jax.random.PRNGKey(salt)
 
+    if args.draft:
+        return _bench_speculative(args, cfg, params, tokens, lengths)
+    if args.serve:
+        return _bench_serving(args, cfg, params)
+
+    # Synchronization caveat on this tunnel runtime: blocking a SINGLE
+    # output array does NOT wait for remote completion (measured ~2 ms
+    # "walls" for 128-step programs); jax.block_until_ready over the
+    # WHOLE output tree does. Every timed leg below must use the
+    # tree-level sync or the numbers are dispatch time, not compute.
     def make_run(run_cfg):
         def run(seed_key):
             out = generate(
@@ -150,7 +183,7 @@ def main() -> int:
                 shared_prefill=not args.no_shared_prefill,
                 kv_quant=args.kv_quant == "int8",
             )
-            return out.tokens
+            return out
 
         return run
 
@@ -162,7 +195,7 @@ def main() -> int:
     # say so in the metric string.
     t0 = time.perf_counter()
     try:
-        run(key).block_until_ready()
+        jax.block_until_ready(run(key))
     except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
         if not cfg.use_pallas:
             raise
@@ -176,14 +209,14 @@ def main() -> int:
         run = make_run(cfg)
         fallback = " FALLBACK:no-pallas"
         t0 = time.perf_counter()
-        run(key).block_until_ready()
+        jax.block_until_ready(run(key))
     compile_s = time.perf_counter() - t0
     print(f"[bench] compile+first run: {compile_s:.1f}s", file=sys.stderr)
 
-    # Timed steady-state.
+    # Timed steady-state (tree-level sync per iteration — see above).
     t0 = time.perf_counter()
     for i in range(args.iters):
-        run(jax.random.fold_in(key, i + 1)).block_until_ready()
+        jax.block_until_ready(run(jax.random.fold_in(key, i + 1)))
     wall = (time.perf_counter() - t0) / args.iters
 
     candidate_tokens = b * args.new_tokens
@@ -200,6 +233,175 @@ def main() -> int:
                 "value": round(tps_per_chip, 2),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tps_per_chip / 1000.0, 4),
+            }
+        )
+    )
+    return 0
+
+
+def _bench_speculative(args, cfg, params, tokens, lengths) -> int:
+    """Speculative-decoding bench leg: greedy spec vs plain greedy.
+
+    Reports acceptance rate (SpecOutput.accepted/drafted) and the
+    speedup over the plain path at the same shapes. `--draft self`
+    measures the acceptance=1.0 ceiling (pure overhead); a real draft
+    preset measures what its agreement with the target actually buys —
+    with RANDOM weights the two models agree at chance, so treat the
+    preset number as the pessimistic floor and `self` as the ceiling.
+    """
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.engine.generate import generate
+    from llm_consensus_tpu.engine.speculative import speculative_generate
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+
+    b = tokens.shape[0]
+    if args.draft == "self":
+        d_cfg, d_params = cfg, params
+    else:
+        d_cfg = get_config(args.draft).with_(use_pallas=cfg.use_pallas)
+        d_params = init_params(d_cfg, jax.random.PRNGKey(1), dtype=jnp.bfloat16)
+    print(
+        f"[bench] speculative: draft={d_cfg.name} k_spec={args.k_spec}",
+        file=sys.stderr,
+    )
+
+    # Inputs are SALTED per process AND perturbed per iteration: this
+    # tunnel runtime short-circuits repeat executions of a previously
+    # seen (executable, inputs) pair — even across processes (measured:
+    # "128 sequential decode steps in 1.3 ms", physically impossible,
+    # for exactly the input values an earlier invocation had run). A
+    # time-derived token perturbation guarantees fresh work without
+    # changing the workload.
+    salt = int(time.time() * 1e6) % 29989
+
+    def run_spec(i):
+        toks = tokens.at[0, 0].set(1 + (salt + i) % 30000)
+        return speculative_generate(
+            cfg, params, d_cfg, d_params, toks, lengths,
+            max_new_tokens=args.new_tokens, k_spec=args.k_spec,
+            eos_id=-1, pad_id=0,
+        )
+
+    def run_plain(i):
+        toks = tokens.at[0, 0].set(1 + (salt + i) % 30000)
+        return generate(
+            cfg, params, toks, lengths,
+            jax.random.fold_in(jax.random.PRNGKey(salt), i),
+            jnp.zeros((b,), jnp.float32),
+            max_new_tokens=args.new_tokens, eos_id=-1,
+            # bf16 KV on BOTH legs: speculative_generate has no quant-KV
+            # path, and the speedup figure must isolate speculation, not
+            # conflate it with the KV-quant delta.
+            kv_quant=False,
+        )
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(run_spec(0))
+    jax.block_until_ready(run_plain(0))
+    print(
+        f"[bench] compile+first run: {time.perf_counter() - t0:.1f}s",
+        file=sys.stderr,
+    )
+    # Tree-level sync (see main()): single-array block does not wait on
+    # this tunnel runtime.
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        out = run_spec(i + 1)
+        jax.block_until_ready(out)
+    spec_wall = (time.perf_counter() - t0) / args.iters
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        jax.block_until_ready(run_plain(i + 1))
+    plain_wall = (time.perf_counter() - t0) / args.iters
+
+    produced = float(jnp.sum(out.num_tokens))
+    acc = float(out.accepted) / max(1.0, float(out.drafted))
+    spec_tps = produced / spec_wall
+    plain_tps = b * args.new_tokens / plain_wall
+    print(
+        json.dumps(
+            {
+                "metric": f"speculative tokens/sec/chip ({cfg.name} + draft "
+                f"{d_cfg.name}, N={b}, k={args.k_spec}, decode "
+                f"{args.new_tokens} @ prompt {tokens.shape[1]}, "
+                f"acceptance={acc:.3f}, plain={plain_tps:.0f} tok/s, "
+                f"speedup={spec_tps / plain_tps:.2f}x)",
+                "value": round(spec_tps, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(spec_tps / 1000.0, 4),
+            }
+        )
+    )
+    return 0
+
+
+def _bench_serving(args, cfg, params) -> int:
+    """Continuous-batching throughput: a burst of requests interleaved
+    at decode-step granularity over the paged cache (the paged Pallas
+    decode-attention kernel on TPU). Reports requests/sec; tokens/sec
+    rides in the metric string."""
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    pg = 64
+    pages_per_seq = -(-(256 + args.new_tokens) // pg)
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2  # 2x headroom
+    batcher = ContinuousBatcher(
+        cfg,
+        params,
+        config=ContinuousConfig(
+            max_slots=args.serve_slots,
+            page_size=pg,
+            n_pages=n_pages,
+            pages_per_seq=pages_per_seq,
+            max_new_tokens=args.new_tokens,
+            seq_buckets=(64, 128, 256),
+        ),
+    )
+    # Salted prompts (the tunnel runtime replays previously-seen
+    # (executable, inputs) pairs — see main()); byte tokenizer: 1 token
+    # per byte, so pad with 13-byte repeats to ~prompt_len tokens.
+    salt = int(time.time() * 1e6) % 999983
+    prompts = [
+        f"Request {salt}-{i}: summarize item {i * 37 % 101} "
+        + "with context " * (max(0, args.prompt_len - 40) // 13)
+        for i in range(args.serve_requests)
+    ]
+    try:
+        # Warmup: compile prefill buckets + the decode-step program.
+        batcher.submit(prompts[0], max_new_tokens=args.new_tokens).result(
+            timeout=600
+        )
+        steps_before = batcher.stats()["decode_steps"]
+        t0 = time.perf_counter()
+        futs = [
+            batcher.submit(p, max_new_tokens=args.new_tokens)
+            for p in prompts
+        ]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+    finally:
+        batcher.close()
+    n_tokens = sum(r.num_tokens for r in results)
+    rps = len(results) / wall
+    # Timed-window step count only (warmup decoded solo before t0).
+    steps = batcher.stats()["decode_steps"] - steps_before
+    print(
+        json.dumps(
+            {
+                "metric": f"serving requests/sec ({cfg.name}, "
+                f"{args.serve_requests} reqs, slots={args.serve_slots}, "
+                f"decode {args.new_tokens} @ ~{args.prompt_len} prompt, "
+                f"paged pallas={cfg.use_pallas}, "
+                f"{n_tokens / wall:.0f} generated tok/s, "
+                f"{steps} decode steps)",
+                "value": round(rps, 2),
+                "unit": "requests/sec",
+                "vs_baseline": round(rps, 4),
             }
         )
     )

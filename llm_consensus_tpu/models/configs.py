@@ -127,6 +127,20 @@ PRESETS: dict[str, ModelConfig] = {
         moe_capacity_factor=1.25,
         max_seq_len=8192,
     ),
+    # ~100M draft model sharing llama-1b's vocab — the speculative-
+    # decoding draft for `bench.py --draft llama-draft-100m` (same
+    # tokenizer/vocab is the only hard requirement for speculation).
+    "llama-draft-100m": ModelConfig(
+        name="llama-draft-100m",
+        vocab_size=32000,
+        d_model=768,
+        n_layers=8,
+        n_heads=12,
+        n_kv_heads=4,
+        d_ff=2048,
+        rope_theta=10000.0,
+        max_seq_len=4096,
+    ),
     # ~1.1B dense config for single-chip benchmarking (fits v5e HBM in bf16
     # with a large candidate batch).
     "llama-1b": ModelConfig(

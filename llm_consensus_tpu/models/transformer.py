@@ -962,6 +962,14 @@ def decode_step_paged(
     offset = pos % pg
     tables = cache.page_table  # [B, P]
 
+    # The paged Pallas kernel walks each row's pages through the
+    # scalar-prefetched table (only real pages stream to VMEM); the jnp
+    # path materializes k_pool[tables] — every row's full padded
+    # sequence — per layer per step. Kernel is the serving hot path on
+    # TPU; sliding-window configs keep the gather path (the kernel has
+    # no window rule yet).
+    use_paged_kernel = cfg.use_pallas and cfg.sliding_window == 0
+
     def body(carry, layer_in):
         p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
         h = _rms(cfg, carry, p["attn_norm"])
@@ -970,11 +978,24 @@ def decode_step_paged(
         k = apply_rope(k, cos, sin)
         k_pool = k_pool.at[pages_now, offset].set(k[:, 0].astype(k_pool.dtype))
         v_pool = v_pool.at[pages_now, offset].set(v[:, 0].astype(v_pool.dtype))
-        k_seq = k_pool[tables].reshape(b, -1, cfg.n_kv_heads, cfg.head_dim)
-        v_seq = v_pool[tables].reshape(b, -1, cfg.n_kv_heads, cfg.head_dim)
-        attn = decode_attention(
-            q, k_seq, v_seq, pos + 1, window=cfg.sliding_window
-        )
+        if use_paged_kernel:
+            from llm_consensus_tpu.ops.pallas.attention import (
+                paged_decode_attention,
+            )
+
+            attn = paged_decode_attention(
+                q[:, 0], k_pool, v_pool, tables, pos + 1
+            )[:, None]  # [B, H, D] -> [B, 1, H, D] (seq axis restored)
+        else:
+            k_seq = k_pool[tables].reshape(
+                b, -1, cfg.n_kv_heads, cfg.head_dim
+            )
+            v_seq = v_pool[tables].reshape(
+                b, -1, cfg.n_kv_heads, cfg.head_dim
+            )
+            attn = decode_attention(
+                q, k_seq, v_seq, pos + 1, window=cfg.sliding_window
+            )
         y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
         y = y + _mlp(cfg, p, h2)

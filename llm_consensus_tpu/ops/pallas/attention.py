@@ -546,3 +546,160 @@ def _decode_q8_stacked_kernel(
             scale,
         )
         o_ref[0, head] = out.astype(o_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention (vLLM-style page tables, TPU-native)
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    tbl_ref,  # [B*P] int32 scalar-prefetch: flattened page table
+    len_ref,  # [B] int32 scalar-prefetch: valid lengths
+    q_ref,  # [1, 1, G, D]
+    k_ref,  # [1, pg, 1, D] — ONE page of the pool for this kv head
+    v_ref,
+    o_ref,  # [1, 1, G, D]
+    m_ref,  # [G, 1] f32 scratch: running max
+    l_ref,  # [G, 1] f32 scratch: running denominator
+    acc_ref,  # [G, D] f32 scratch: running numerator
+    *,
+    scale: float,
+):
+    """One (row, kv-head, page) program — online softmax across pages.
+
+    The page grid dimension is innermost, so TPU's sequential grid
+    execution makes the VMEM scratch a legal accumulator: page j=0
+    initializes, every page folds its [G, pg] score tile in, the last
+    page writes ``acc / l``. Pages beyond the row's valid length
+    contribute exp(-inf)=0 — the NULL page's garbage never reaches the
+    output, mirroring the gather path's masking."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    _, pg, _, d = k_ref.shape
+    g = q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full((g, 1), _NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros((g, 1), jnp.float32)
+        acc_ref[...] = jnp.zeros((g, d), jnp.float32)
+
+    valid = len_ref[b]
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+    k = k_ref[0, :, 0, :]  # [pg, D]
+    scores = jax.lax.dot_general(
+        q,
+        k.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [G, pg]
+    slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
+    scores = jnp.where(slot < valid, scores, _NEG_INF)
+
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    # A fully-masked page (or row) keeps m at -inf; exp(-inf - -inf)
+    # would be NaN — substitute 0 so p stays 0 for masked slots.
+    m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(scores - m_safe)  # [G, pg]
+    alpha = jnp.where(
+        m_prev <= _NEG_INF / 2, 0.0, jnp.exp(m_prev - m_safe)
+    )
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p,
+        v_ref[0, :, 0, :].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [G, D]
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _write():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Decode attention THROUGH the page table — no pool gather.
+
+    q: [B, H, D]; k_pool/v_pool: [n_pages, page, Hkv, D] (one layer's
+    pool); page_table: [B, P] int32 page ids (NULL page for unused
+    slots); valid_len: [B] tokens readable per row. Returns [B, H, D].
+
+    The jnp reference path (``decode_step_paged``'s
+    ``k_pool[tables]``) materializes every row's full padded sequence
+    out of the pool per layer per step — O(B * P * page) HBM traffic
+    regardless of true lengths. Here each (row, kv-head) program walks
+    the row's OWN pages via the scalar-prefetched table: the BlockSpec
+    index map reads ``page_table`` to choose which pool page lands in
+    VMEM, so only real pages are streamed and the score tile never
+    touches HBM. SURVEY §7's "ragged/paged decode attention in Pallas"
+    hard part, paged half.
+    """
+    b, h, d = q.shape
+    n_pages, pg, hkv, _ = k_pool.shape
+    p_per = page_table.shape[1]
+    g = h // hkv
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+
+    # [B, Hkv, G, D] q blocks; pool stays in its native layout (any
+    # transpose would materialize the whole pool and defeat the point).
+    q4 = q.reshape(b, hkv, g, d)
+    tbl = page_table.reshape(-1).astype(jnp.int32)
+    lens = valid_len.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page table, valid lengths
+        grid=(b, hkv, p_per),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, g, d), lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, pg, 1, d),
+                lambda bi, hi, ji, tbl, lens: (
+                    tbl[bi * p_per + ji],
+                    0,
+                    hi,
+                    0,
+                ),
+            ),
+            pl.BlockSpec(
+                (1, pg, 1, d),
+                lambda bi, hi, ji, tbl, lens: (
+                    tbl[bi * p_per + ji],
+                    0,
+                    hi,
+                    0,
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tbl, lens, q4, k_pool, v_pool)
+    return out.reshape(b, h, d)
