@@ -105,11 +105,7 @@ def _build_backend(args):
     if args.mesh:
         from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
 
-        sizes = {}
-        for part in args.mesh.split(","):
-            axis, _, n = part.partition("=")
-            sizes[axis.strip()] = int(n)
-        mesh = make_mesh(MeshConfig(**sizes))
+        mesh = make_mesh(MeshConfig(**_parse_axes(args.mesh)))
         if mesh.shape.get("seq", 1) > 1:
             cfg = cfg.with_(use_ring=True)
     engine = InferenceEngine(
@@ -210,7 +206,82 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--eval-n", type=int, default=8, help="candidates per problem")
     p.add_argument("--eval-limit", type=int, default=20)
+    p.add_argument(
+        "--plan",
+        action="store_true",
+        help="print the HBM capacity plan for --model at --plan-n/"
+        "--plan-context (config-only, nothing is allocated): does the "
+        "config fit one chip, and what does a mesh buy? Honors "
+        "--plan-quant/--plan-mesh (e.g. 'data=4,model=2').",
+    )
+    p.add_argument("--plan-n", type=int, default=64)
+    p.add_argument("--plan-context", type=int, default=2048)
+    p.add_argument(
+        "--plan-quant", default="int8", choices=("none", "int8", "int4")
+    )
+    p.add_argument(
+        "--plan-kv",
+        default="int8",
+        choices=("none", "int8"),
+        help="KV-cache quantization the plan assumes (bf16 doubles the "
+        "cache term)",
+    )
+    p.add_argument("--plan-mesh", default="", metavar="AXIS=N,...")
+    p.add_argument(
+        "--plan-hbm-gib", type=float, default=16.0, help="per-chip HBM"
+    )
     return p
+
+
+def _parse_axes(spec: str) -> dict[str, int]:
+    """``"data=4,model=2"`` -> ``{"data": 4, "model": 2}`` — the one
+    parser behind both ``--mesh`` and ``--plan-mesh``."""
+    sizes: dict[str, int] = {}
+    for part in spec.split(","):
+        axis, sep, n = part.partition("=")
+        if not sep or not axis.strip() or not n.strip():
+            raise SystemExit(
+                f"bad mesh axis spec {part!r} (want AXIS=N,...)"
+            )
+        sizes[axis.strip()] = int(n)
+    return sizes
+
+
+def _run_plan(args) -> int:
+    """Capacity planning without touching a device (``--plan``)."""
+    import json as _json
+
+    from llm_consensus_tpu.engine.engine import plan_memory
+    from llm_consensus_tpu.models.configs import get_config
+
+    mesh_shape = _parse_axes(args.plan_mesh) if args.plan_mesh else {}
+    prompt = max(1, args.plan_context - args.max_new_tokens)
+    plan = plan_memory(
+        get_config(args.model),
+        quant=args.plan_quant,
+        kv_quant=args.plan_kv == "int8",
+        n_candidates=args.plan_n,
+        prompt_len=prompt,
+        new_tokens=args.max_new_tokens,
+        mesh_shape=mesh_shape or None,
+        hbm_bytes=int(args.plan_hbm_gib * (1 << 30)),
+    )
+    gib = 1 << 30
+    out = {
+        "model": args.model,
+        "quant": args.plan_quant,
+        "kv_quant": args.plan_kv,
+        "n_candidates": args.plan_n,
+        "context": args.plan_context,
+        "mesh": mesh_shape or "single chip",
+        "params_gib": round(plan["params_bytes"] / gib, 2),
+        "kv_cache_gib": round(plan["kv_cache_bytes"] / gib, 2),
+        "total_gib": round(plan["total_bytes"] / gib, 2),
+        "hbm_gib": args.plan_hbm_gib,
+        "fits": plan["fits"],
+    }
+    print(_json.dumps(out, indent=2))
+    return 0 if plan["fits"] else 1
 
 
 async def repl(coord: Coordinator, stream=None) -> None:
@@ -238,6 +309,8 @@ def main(argv: list[str] | None = None) -> int:
     _init_logging()
     args = build_parser().parse_args(argv)
 
+    if args.plan:
+        return _run_plan(args)
     if args.eval_gsm8k is not None:
         return _run_eval(args)
     if args.debate is not None:

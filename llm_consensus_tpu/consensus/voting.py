@@ -249,18 +249,37 @@ def heterogeneous_panel_vote(
     ``n_per_model`` candidates (one batched program per model — models
     have different weights/meshes so they cannot share a batch); every
     candidate votes with its model's weight.
+
+    The per-model calls run CONCURRENTLY (one thread per engine): on a
+    single shared chip they still serialize on the device queue, but
+    engines on disjoint meshes/hosts — the deployment config[3]
+    describes — overlap fully, and even single-chip panels overlap each
+    model's host-side tokenize/detokenize work. Seeds are per-model
+    (seed + model index in sorted-name order), so results are identical
+    to the sequential path regardless of completion order.
     """
-    answers: list[str] = []
-    weights: list[float] = []
-    per_model: dict[str, list[str]] = {}
-    total_tokens = 0
-    for mi, (name, (engine, weight)) in enumerate(sorted(engines.items())):
+    from concurrent.futures import ThreadPoolExecutor
+
+    ordered = sorted(engines.items())
+
+    def _one(mi_name_ew):
+        mi, (name, (engine, weight)) = mi_name_ew
         results = engine.generate_texts(
             [prompt] * n_per_model,
             temperatures=[temperature] * n_per_model,
             seed=seed + mi,
             max_new_tokens=max_new_tokens,
         )
+        return name, weight, results
+
+    with ThreadPoolExecutor(max_workers=max(1, len(ordered))) as ex:
+        outs = list(ex.map(_one, enumerate(ordered)))
+
+    answers: list[str] = []
+    weights: list[float] = []
+    per_model: dict[str, list[str]] = {}
+    total_tokens = 0
+    for name, weight, results in outs:  # sorted-name order preserved
         texts = [r.text for r in results]
         per_model[name] = texts
         answers.extend(texts)

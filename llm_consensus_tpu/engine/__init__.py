@@ -7,7 +7,11 @@ self-consistency batch is ONE compiled device program: prefill + a
 ``lax.scan`` decode loop over static shapes.
 """
 
-from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+from llm_consensus_tpu.engine.engine import (
+    EngineConfig,
+    InferenceEngine,
+    plan_memory,
+)
 from llm_consensus_tpu.engine.generate import (
     GenerateOutput,
     decode_steps,
@@ -16,7 +20,11 @@ from llm_consensus_tpu.engine.generate import (
     score_completions,
 )
 from llm_consensus_tpu.engine.prefix_cache import PrefixCache
-from llm_consensus_tpu.engine.sampler import SamplerConfig, sample_token
+from llm_consensus_tpu.engine.sampler import (
+    SamplerConfig,
+    sample_token,
+    sample_token_per_request,
+)
 from llm_consensus_tpu.engine.speculative import (
     SpecOutput,
     leviathan_accept,
@@ -44,5 +52,7 @@ __all__ = [
     "leviathan_accept",
     "load_tokenizer",
     "sample_token",
+    "sample_token_per_request",
+    "plan_memory",
     "speculative_generate",
 ]

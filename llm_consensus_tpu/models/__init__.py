@@ -2,6 +2,7 @@ from llm_consensus_tpu.models.configs import ModelConfig, get_config, PRESETS
 from llm_consensus_tpu.models.cache import KVCache
 from llm_consensus_tpu.models.transformer import (
     init_params,
+    init_params_quantized,
     forward,
     prefill,
     prefill_chunked,
@@ -16,6 +17,7 @@ __all__ = [
     "PRESETS",
     "KVCache",
     "init_params",
+    "init_params_quantized",
     "forward",
     "prefill",
     "prefill_chunked",

@@ -17,6 +17,7 @@ from llm_consensus_tpu.ops.pallas.attention import (
     flash_decode_attention,
     flash_decode_attention_q8,
     flash_decode_attention_q8_stacked,
+    paged_decode_attention,
 )
 from llm_consensus_tpu.ops.pallas.norms import fused_rms_norm
 from llm_consensus_tpu.ops.pallas.quant_matmul import quant_matmul_2d
@@ -26,6 +27,7 @@ __all__ = [
     "flash_decode_attention",
     "flash_decode_attention_q8",
     "flash_decode_attention_q8_stacked",
+    "paged_decode_attention",
     "fused_rms_norm",
     "quant_matmul_2d",
 ]

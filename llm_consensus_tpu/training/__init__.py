@@ -7,6 +7,7 @@ conditioning scales past prompt engineering — and the same sharded train
 step is the multi-chip dry-run surface (``__graft_entry__.dryrun_multichip``).
 """
 
+from llm_consensus_tpu.training.data import SftBatchLoader, TokenBatchLoader
 from llm_consensus_tpu.training.loop import (
     LoopConfig,
     TrainReport,
@@ -22,6 +23,8 @@ from llm_consensus_tpu.training.train import (
 )
 
 __all__ = [
+    "SftBatchLoader",
+    "TokenBatchLoader",
     "LoopConfig",
     "TrainConfig",
     "TrainReport",

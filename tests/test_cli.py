@@ -198,3 +198,31 @@ def test_cli_stream_requires_local_backend():
 
     assert main(["--stream", "--question", "q"]) == 2
     assert main(["--backend", "local", "--model", "test-tiny", "--stream"]) == 2
+
+
+def test_plan_capacity_command(capsys):
+    """--plan prints a config-only HBM plan and exits 1 when the config
+    cannot fit the budget (scripting-friendly capacity checks)."""
+    import json
+
+    from llm_consensus_tpu.cli import main
+
+    rc = main(
+        [
+            "--plan", "--model", "mixtral-8x7b", "--plan-n", "64",
+            "--plan-context", "256", "--max-new-tokens", "128",
+            "--plan-mesh", "expert=4,model=2",
+        ]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["fits"] is True
+    assert out["params_gib"] > out["kv_cache_gib"] > 0
+
+    rc = main(
+        [
+            "--plan", "--model", "mixtral-8x7b", "--plan-n", "64",
+            "--plan-context", "256", "--max-new-tokens", "128",
+        ]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["fits"] is False  # 44.7 GiB on one chip
