@@ -86,29 +86,17 @@ def train(args, cfg, tok) -> None:
 
 def load_engine(args, cfg, tok) -> InferenceEngine:
     """Reload the latest checkpoint from disk into a fresh engine."""
-    from llm_consensus_tpu.checkpoint.io import restore_train_state
-    from llm_consensus_tpu.models.transformer import init_params
-    from llm_consensus_tpu.training.loop import _latest_checkpoint
-    from llm_consensus_tpu.training.train import init_train_state
+    from llm_consensus_tpu.checkpoint.io import restore_params_for_inference
 
-    ckpt = _latest_checkpoint(args.ckpt_dir)
-    if ckpt is None:
-        raise SystemExit(f"no checkpoint under {args.ckpt_dir}; train first")
-    tcfg = TrainConfig(total_steps=args.steps)
-    template = jax.eval_shape(
-        lambda: init_train_state(
-            cfg, init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32),
-            tcfg,
+    try:
+        params, step = restore_params_for_inference(
+            cfg, args.ckpt_dir, jnp.bfloat16
         )
-    )
-    state, extra = restore_train_state(ckpt, template)
-    step = (extra or {}).get("step", "?")
-    print(f"[eval] restored {ckpt} (step {step})", file=sys.stderr)
-    params = jax.tree_util.tree_map(
-        lambda x: x.astype(jnp.bfloat16)
-        if hasattr(x, "dtype") and x.dtype == jnp.float32
-        else x,
-        state.params,
+    except FileNotFoundError as e:
+        raise SystemExit(f"{e}; train first") from e
+    print(
+        f"[eval] restored from {args.ckpt_dir} (step {step})",
+        file=sys.stderr,
     )
     return InferenceEngine(
         cfg,

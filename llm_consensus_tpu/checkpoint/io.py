@@ -78,3 +78,42 @@ def restore_train_state(path: str | Path, target):
     meta_file = path / "meta.json"
     extra = json.loads(meta_file.read_text()) if meta_file.exists() else None
     return state, extra
+
+
+def restore_params_for_inference(cfg, ckpt_dir, dtype=None):
+    """Reload a training checkpoint's params for an InferenceEngine.
+
+    The one restore recipe shared by the example scripts (train_arith_em
+    eval phase, spec_arith_demo): resolve the newest complete checkpoint
+    under ``ckpt_dir`` (training.loop's LATEST-pointer layout), restore
+    through an abstract TrainState template, and cast float32 leaves to
+    ``dtype`` (bfloat16 for TPU decode) leaving everything else alone.
+    Returns (params, step_or_None).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.training.loop import _latest_checkpoint
+    from llm_consensus_tpu.training.train import TrainConfig, init_train_state
+
+    ckpt = _latest_checkpoint(str(ckpt_dir))
+    if ckpt is None:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    template = jax.eval_shape(
+        lambda: init_train_state(
+            cfg,
+            init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32),
+            TrainConfig(),
+        )
+    )
+    state, extra = restore_train_state(ckpt, template)
+    params = state.params
+    if dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(dtype)
+            if hasattr(x, "dtype") and x.dtype == jnp.float32
+            else x,
+            params,
+        )
+    return params, (extra or {}).get("step")

@@ -168,6 +168,20 @@ PRESETS: dict[str, ModelConfig] = {
         d_ff=1536,
         max_seq_len=512,
     ),
+    # ~2.5M draft for arith-14m: trained on the same corpus it gives a
+    # REAL speculative-decoding acceptance rate (examples/
+    # spec_arith_demo.py) — between bench.py's --draft self ceiling and
+    # random-weight floor.
+    "arith-3m": ModelConfig(
+        name="arith-3m",
+        vocab_size=384,
+        d_model=192,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=4,
+        d_ff=768,
+        max_seq_len=512,
+    ),
     # Tiny configs for tests (CPU-simulated meshes). vocab 384 >= the
     # ByteTokenizer's 259 ids so end-to-end text tests can run on them.
     "test-tiny": ModelConfig(
