@@ -54,6 +54,15 @@ def main() -> int:
     p.add_argument("--train-draft", action="store_true")
     p.add_argument("--draft-steps", type=int, default=6000)
     p.add_argument("--n-prompts", type=int, default=16)
+    p.add_argument(
+        "--holdout-n",
+        type=int,
+        default=50,
+        help="size of the eval holdout the checkpoints were trained "
+        "with (train_arith_em --n-problems; eval seed must match too) — "
+        "prompts past this index were TRAINED ON and would inflate "
+        "acceptance",
+    )
     p.add_argument("--max-new-tokens", type=int, default=48)
     p.add_argument("--k-spec", type=int, default=4)
     p.add_argument("--iters", type=int, default=3)
@@ -86,13 +95,14 @@ def main() -> int:
     d_cfg, d_params = _load_params("arith-3m", args.draft_ckpt)
     tok = ByteTokenizer()
 
-    if args.n_prompts > 50:
-        # Training held out exactly the first 50 eval problems' triples
-        # (train_arith_em defaults); prompts past index 49 were TRAINED
-        # ON by both models and would inflate the acceptance number.
+    if args.n_prompts > args.holdout_n:
+        # Training held out exactly the first --holdout-n eval problems'
+        # triples; prompts past that index were TRAINED ON by both
+        # models and would inflate the acceptance number.
         raise SystemExit(
-            "--n-prompts > 50 would include prompts from the training "
-            "corpus (the holdout is the first 50 eval problems)"
+            f"--n-prompts {args.n_prompts} exceeds the training holdout "
+            f"({args.holdout_n}; see --holdout-n) — extra prompts come "
+            "from the training corpus"
         )
     problems, _ = eval_split(args.n_prompts, seed=0)
     prompts = [_PROMPT.format(q=pr.question) for pr in problems]

@@ -1117,3 +1117,46 @@ def test_plan_memory_matches_memory_estimate(tiny):
     # A 16 GiB budget fits the tiny model; 1 KiB does not.
     assert plan_memory(cfg, hbm_bytes=16 << 30)["fits"]
     assert not plan_memory(cfg, hbm_bytes=1 << 10)["fits"]
+
+
+def test_per_request_sampler_matches_static_on_kth_ties():
+    """Fused per-request top-k/top-p must keep tokens TIED at the kth
+    logit exactly like the sequential static filters (value-mask, not
+    position-mask — a position mask would drop ties from the nucleus)."""
+    from llm_consensus_tpu.engine.sampler import (
+        _NEG_INF,
+        _apply_top_k,
+        _apply_top_p,
+        sample_token_per_request,
+    )
+
+    # Row with an exact tie at the kth (k=2) position.
+    lg = jnp.array(
+        [[3.0, 2.0, 2.0, 0.0, -1.0], [1.0, 5.0, 4.0, 4.0, 0.0]],
+        jnp.float32,
+    )
+    t = jnp.array([1.0, 1.0], jnp.float32)
+    want = _apply_top_p(_apply_top_k(lg, 2), 0.9)
+    allowed = np.asarray(want) > _NEG_INF / 2
+    seen: list[set] = [set(), set()]
+    for s in range(96):
+        tokr, _ = sample_token_per_request(
+            lg,
+            jax.random.split(jax.random.PRNGKey(s), 2),
+            t,
+            jnp.full((2,), 2, jnp.int32),
+            jnp.full((2,), 0.9, jnp.float32),
+        )
+        for r in range(2):
+            assert allowed[r, int(tokr[r])], (s, r, int(tokr[r]))
+            seen[r].add(int(tokr[r]))
+    # COVERAGE, not just membership: a position-mask regression that
+    # drops the tied kth token would still pass membership (its support
+    # is a strict subset) — the empirical support must equal the
+    # sequential filters' full allowed set, ties included.
+    for r in range(2):
+        assert seen[r] == set(np.nonzero(allowed[r])[0].tolist()), (
+            r,
+            seen[r],
+            allowed[r],
+        )

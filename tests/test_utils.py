@@ -92,3 +92,35 @@ def test_engine_records_speculative_spans():
     ]
     assert len(spans) == 1
     assert spans[0].meta["k_spec"] == 4
+
+
+def test_earliest_stop_cut_and_tail_window():
+    """The shared stop rules (utils/stops): earliest occurrence wins
+    across stops; window covers worst-case one-byte-per-token emission
+    even when the tokenizer's own encoding is shorter."""
+    from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+    from llm_consensus_tpu.utils.stops import (
+        earliest_stop_cut,
+        stop_tail_window,
+    )
+
+    assert earliest_stop_cut("abcdef", ["cd", "ef"]) == 2
+    assert earliest_stop_cut("abcdef", ["ef", "cd"]) == 2  # order-free
+    assert earliest_stop_cut("abcdef", ["zz"]) == -1
+    assert earliest_stop_cut("", ["a"]) == -1
+
+    tok = ByteTokenizer()
+    assert stop_tail_window(tok, []) == 0
+    # Byte tokenizer: window = byte length + slack.
+    assert stop_tail_window(tok, ["\n\n"]) == 2 + 8
+    assert stop_tail_window(tok, ["ab", "abcd"]) == 4 + 8
+
+    class MergeTok:
+        """Stub merge-based tokenizer: whole string -> one id."""
+
+        def encode(self, s, add_bos=True):
+            return [7]
+
+    # Even though the tokenizer encodes the stop as ONE id, a model can
+    # emit it one byte-ish token at a time: the byte length must win.
+    assert stop_tail_window(MergeTok(), ["Final answer"]) == 12 + 8
