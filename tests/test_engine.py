@@ -711,6 +711,63 @@ def test_prefix_with_multi_token_stop_trims_and_exits_early(tiny):
         assert got.num_tokens <= 12  # early exit, not trim-at-the-end
 
 
+def test_multi_token_stop_on_mesh_matches_single_device(tiny):
+    """The chunked multi-token-stop decode on a dp=8 mesh (sharded
+    cache, device_put done-mask updates between chunks) must trim
+    exactly like the single-device path."""
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg, params = tiny
+    ecfg = EngineConfig(
+        seq_buckets=(16,), batch_buckets=(8,), max_new_tokens=24,
+        stop_check_chunk=4,
+    )
+    mesh = make_mesh(MeshConfig(data=8))
+    single = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(16,), batch_buckets=(1, 2), max_new_tokens=24,
+            stop_check_chunk=4,
+        ),
+    )
+    sharded = InferenceEngine(cfg, params, engine_config=ecfg, mesh=mesh)
+    free = single.generate_texts(["tell me a fact"])[0]
+    if len(free.text) < 3:
+        pytest.skip("output too short to split")
+    stop = free.text[1:3]
+    want = single.generate_texts(["tell me a fact"], stop=[stop])[0]
+    got = sharded.generate_texts(["tell me a fact"], stop=[stop])[0]
+    assert got.text == want.text == free.text[:1]
+
+
+def test_prefix_multi_stop_kv_quant_combination(tiny):
+    """All three features composed: prefix cache + multi-token stop +
+    int8 KV — the quant prefill_from_prefix feeds the chunked-stop
+    decode; output is deterministic, trimmed, and the stop is honored."""
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(32,), batch_buckets=(1, 2), max_new_tokens=24,
+            stop_check_chunk=4, kv_quant=True,
+        ),
+    )
+    prefix = "Shared header: "
+    q = free = None
+    for cand in ("what is 2+2?", "tell me a fact", "abc", "longer query?"):
+        r = eng.generate_texts([cand], prefix=prefix)[0]
+        if len(r.text) >= 3:
+            q, free = cand, r
+            break
+    if free is None:
+        pytest.skip("all outputs too short to split")
+    stop = free.text[1:3]
+    got1 = eng.generate_texts([q], prefix=prefix, stop=[stop])[0]
+    got2 = eng.generate_texts([q], prefix=prefix, stop=[stop])[0]
+    assert got1.text == got2.text == free.text[:1]
+    assert eng.prefix_cache.stats.hits >= 2
+
+
 def test_engine_prefix_shared_suffix_fanout(tiny):
     """N identical suffixes under a prefix == plain shared-prefill run."""
     cfg, params = tiny

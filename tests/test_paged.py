@@ -96,6 +96,30 @@ def test_paged_decode_attention_kernel_matches_gather():
     )
 
 
+def test_paged_decode_attention_kernel_mqa_edge():
+    """MQA (one kv head, all query heads in one group) — the extreme
+    GQA ratio must still match the gather path."""
+    from llm_consensus_tpu.ops.attention import decode_attention
+    from llm_consensus_tpu.ops.pallas.attention import paged_decode_attention
+
+    b, h, hkv, d = 2, 4, 1, 128
+    n_pages, pg, p_per = 6, 8, 3
+    q = jax.random.normal(jax.random.PRNGKey(4), (b, h, d), jnp.float32)
+    k_pool = jax.random.normal(jax.random.PRNGKey(5), (n_pages, pg, hkv, d))
+    v_pool = jax.random.normal(jax.random.PRNGKey(6), (n_pages, pg, hkv, d))
+    tables = jnp.asarray([[4, 1, 0], [2, 0, 0]])
+    valid = jnp.asarray([13, 8], jnp.int32)
+    got = paged_decode_attention(
+        q, k_pool, v_pool, tables, valid, interpret=True
+    )
+    k_seq = k_pool[tables].reshape(b, p_per * pg, hkv, d)
+    v_seq = v_pool[tables].reshape(b, p_per * pg, hkv, d)
+    want = decode_attention(q[:, None], k_seq, v_seq, valid)[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_decode_step_paged_kernel_matches_gather_path():
     """decode_step_paged with cfg.use_pallas routes through the paged
     kernel and must produce the same logits as the gather path."""
