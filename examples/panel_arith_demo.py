@@ -1,0 +1,124 @@
+"""Heterogeneous panel vote with REAL trained engines (config[3]).
+
+BASELINE.md's config[3] is a weighted vote across DIFFERENT models.
+This demo instantiates it with real checkpoints from the arithmetic
+accuracy loop: by default three engines at different training maturities
+(the 6000-step converged model, the 2500-step just-converged model, and
+the 1500-step pre-transition model), each wrapped in its own
+InferenceEngine and voting with its own weight through
+``heterogeneous_panel_vote`` — the per-model calls fan out concurrently.
+Mixing ARCHITECTURES works the same way: repeat ``--model``/``--ckpt``
+pairs (e.g. arith-14m + arith-3m once both are trained).
+
+EM is scored over held-out eval problems, demonstrating that a strong
+model's weight can carry a panel diluted by weak members.
+
+Usage:
+    python examples/panel_arith_demo.py \
+        --ckpt runs/arith14m --ckpt runs/arith14m_mid2 \
+        --ckpt runs/arith14m_mid --weights 3,1,1 [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.checkpoint.io import restore_params_for_inference
+from llm_consensus_tpu.consensus.voting import heterogeneous_panel_vote
+from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+from llm_consensus_tpu.eval.arith import eval_split
+from llm_consensus_tpu.eval.gsm8k import _PROMPT, exact_match
+from llm_consensus_tpu.models.configs import get_config
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--ckpt",
+        action="append",
+        default=None,
+        help="checkpoint dir (repeat; default: the three arith-14m "
+        "training stages)",
+    )
+    p.add_argument(
+        "--model",
+        action="append",
+        default=None,
+        help="model preset per --ckpt (default arith-14m for each)",
+    )
+    p.add_argument("--weights", default="3,1,1")
+    p.add_argument("--n-problems", type=int, default=20)
+    p.add_argument("--n-per-model", type=int, default=4)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    ckpts = args.ckpt or [
+        "runs/arith14m",
+        "runs/arith14m_mid2",
+        "runs/arith14m_mid",
+    ]
+    models = args.model or ["arith-14m"] * len(ckpts)
+    weights = [float(w) for w in args.weights.split(",")]
+    if not (len(ckpts) == len(models) == len(weights)):
+        raise SystemExit("--ckpt/--model/--weights must align")
+
+    tok = ByteTokenizer()
+    engines = {}
+    for i, (ckpt, model, w) in enumerate(zip(ckpts, models, weights)):
+        cfg = get_config(model)
+        params, step = restore_params_for_inference(cfg, ckpt, jnp.bfloat16)
+        eng = InferenceEngine(
+            cfg,
+            params,
+            tokenizer=tok,
+            engine_config=EngineConfig(max_new_tokens=args.max_new_tokens),
+        )
+        # Index prefix: identical (model, dirname, step) members must
+        # not collide in the dict and silently drop a weight.
+        name = f"{i}:{model}@{Path(ckpt).name}(step {step})"
+        engines[name] = (eng, w)
+        print(f"[panel] member {i}: {name} weight={w}", file=sys.stderr)
+
+    problems, _ = eval_split(args.n_problems, seed=0)
+    correct = 0
+    total_tokens = 0
+    for i, prob in enumerate(problems):
+        res = heterogeneous_panel_vote(
+            engines,
+            _PROMPT.format(q=prob.question),
+            n_per_model=args.n_per_model,
+            temperature=args.temperature,
+            seed=100 + i,
+            max_new_tokens=args.max_new_tokens,
+        )
+        total_tokens += res.total_tokens
+        ok = exact_match(res.vote.winner, prob.answer)
+        correct += ok
+    out = {
+        "panel": list(engines),
+        "weights": weights,
+        "n_problems": args.n_problems,
+        "n_per_model": args.n_per_model,
+        "em": round(correct / max(1, args.n_problems), 4),
+        "total_candidate_tokens": total_tokens,
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,6 +20,27 @@ def _ckptr() -> ocp.StandardCheckpointer:
     return ocp.StandardCheckpointer()
 
 
+def _abstractify(tree, sharding=None):
+    """Array leaves -> ShapeDtypeStructs for orbax restore targets.
+
+    ``sharding``: None keeps each leaf's own sharding (or the file's,
+    when the leaf is abstract) — the multi-host-safe default; a concrete
+    Sharding overrides every leaf (restore-to-here, e.g. single-device
+    inference reloads of checkpoints saved on another topology)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape,
+            x.dtype,
+            sharding=sharding
+            if sharding is not None
+            else getattr(x, "sharding", None),
+        )
+        if hasattr(x, "shape")
+        else x,
+        tree,
+    )
+
+
 def save_params(path: str | Path, params: dict) -> None:
     """Save a param pytree to ``path`` (a directory)."""
     path = Path(path).absolute()
@@ -34,15 +55,7 @@ def load_params(path: str | Path, target: dict | None = None) -> dict:
     path = Path(path).absolute()
     ckptr = _ckptr()
     if target is not None:
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-            )
-            if hasattr(x, "shape")
-            else x,
-            target,
-        )
-        return ckptr.restore(path / "params", abstract)
+        return ckptr.restore(path / "params", _abstractify(target))
     return ckptr.restore(path / "params")
 
 
@@ -66,15 +79,7 @@ def restore_train_state(path: str | Path, target):
     """
     path = Path(path).absolute()
     ckptr = _ckptr()
-    abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(
-            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-        )
-        if hasattr(x, "shape")
-        else x,
-        target,
-    )
-    state = ckptr.restore(path / "state", abstract)
+    state = ckptr.restore(path / "state", _abstractify(target))
     meta_file = path / "meta.json"
     extra = json.loads(meta_file.read_text()) if meta_file.exists() else None
     return state, extra
@@ -107,6 +112,16 @@ def restore_params_for_inference(cfg, ckpt_dir, dtype=None):
             TrainConfig(),
         )
     )
+    # Pin CONCRETE single-device shardings on the template: without
+    # them orbax falls back to the sharding recorded in the checkpoint
+    # file, which names devices of the SAVING topology — restoring a
+    # TPU-saved checkpoint in a CPU process (eval/demo runs) would
+    # fail. Restore-to-here is exactly what a single-process inference
+    # reload wants; NOTE this materializes the full fp32 TrainState on
+    # ONE local device — for big-model or multi-host restores use
+    # restore_train_state with properly sharded templates instead.
+    sh = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    template = _abstractify(template, sharding=sh)
     state, extra = restore_train_state(ckpt, template)
     params = state.params
     if dtype is not None:
