@@ -112,16 +112,18 @@ def restore_params_for_inference(cfg, ckpt_dir, dtype=None):
             TrainConfig(),
         )
     )
-    # Pin CONCRETE single-device shardings on the template: without
-    # them orbax falls back to the sharding recorded in the checkpoint
-    # file, which names devices of the SAVING topology — restoring a
-    # TPU-saved checkpoint in a CPU process (eval/demo runs) would
-    # fail. Restore-to-here is exactly what a single-process inference
-    # reload wants; NOTE this materializes the full fp32 TrainState on
-    # ONE local device — for big-model or multi-host restores use
-    # restore_train_state with properly sharded templates instead.
-    sh = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
-    template = _abstractify(template, sharding=sh)
+    # Pin CONCRETE shardings on the HOST CPU device: without them orbax
+    # falls back to the sharding recorded in the checkpoint file, which
+    # names devices of the SAVING topology — restoring a TPU-saved
+    # checkpoint in a CPU process (eval/demo runs) would fail. Staging
+    # through host RAM also means the Adam moments (2x fp32 params)
+    # never touch accelerator HBM: only the cast params are device_put
+    # to the default device at the end. For multi-host or sharded
+    # restores use restore_train_state with properly sharded templates.
+    cpu = jax.local_devices(backend="cpu")[0]
+    template = _abstractify(
+        template, sharding=jax.sharding.SingleDeviceSharding(cpu)
+    )
     state, extra = restore_train_state(ckpt, template)
     params = state.params
     if dtype is not None:
@@ -131,4 +133,8 @@ def restore_params_for_inference(cfg, ckpt_dir, dtype=None):
             else x,
             params,
         )
+    # CPU-committed arrays would pin later jits to the CPU backend;
+    # move the (cast) params to the default device. Accelerator peak =
+    # params only — the optimizer moments stay behind on the host.
+    params = jax.device_put(params, jax.local_devices()[0])
     return params, (extra or {}).get("step")

@@ -43,6 +43,37 @@ def _init_logging() -> None:
     )
 
 
+def _load_checkpoint_params(cfg, path: str):
+    """Load params from either checkpoint layout.
+
+    A training-run directory (training.loop's LATEST-pointer layout,
+    incl. a concrete ``step_N``/legacy flat dir holding ``state``)
+    restores params-for-inference — train with this repo, serve the
+    same dir with no export step. Anything else is a ``save_params``
+    directory. Applies to --checkpoint and --draft-checkpoint alike.
+    """
+    from pathlib import Path
+
+    from llm_consensus_tpu.checkpoint.io import (
+        load_params,
+        restore_params_for_inference,
+    )
+
+    root = Path(path)
+    is_train_dir = (
+        (root / "LATEST").exists()
+        or (root / "state").exists()
+        or any(root.glob("step_*/state"))
+    )
+    if is_train_dir:
+        import jax.numpy as _jnp
+
+        params, step = restore_params_for_inference(cfg, root, _jnp.bfloat16)
+        log.info("loaded train checkpoint %s (step %s)", root, step)
+        return params
+    return load_params(path)
+
+
 def _build_backend(args):
     if args.backend == "fake":
         return FakeBackend()
@@ -69,10 +100,8 @@ def _build_backend(args):
         cfg = config_from_hf(args.hf_checkpoint, name=args.model)
         params = load_hf_params(cfg, args.hf_checkpoint)
     elif args.checkpoint:
-        from llm_consensus_tpu.checkpoint.io import load_params
-
         cfg = get_config(args.model)
-        params = load_params(args.checkpoint)
+        params = _load_checkpoint_params(cfg, args.checkpoint)
     else:
         cfg = get_config(args.model)
         log.warning(
@@ -90,9 +119,7 @@ def _build_backend(args):
     if args.draft_model:
         dcfg = get_config(args.draft_model)
         if args.draft_checkpoint:
-            from llm_consensus_tpu.checkpoint.io import load_params
-
-            dparams = load_params(args.draft_checkpoint)
+            dparams = _load_checkpoint_params(dcfg, args.draft_checkpoint)
         else:
             log.warning(
                 "No --draft-checkpoint: random draft weights for %s "
@@ -127,6 +154,12 @@ def build_parser() -> argparse.ArgumentParser:
         description="Multi-persona LLM consensus on local TPU inference.",
     )
     p.add_argument("--backend", choices=["fake", "local"], default="fake")
+    p.add_argument(
+        "--cpu",
+        action="store_true",
+        help="force the CPU backend (the env may preimport jax with a "
+        "TPU tunnel registered, so JAX_PLATFORMS alone is too late)",
+    )
     p.add_argument("--model", default="llama-1b", help="model preset name")
     p.add_argument("--checkpoint", default=None, help="orbax checkpoint dir")
     p.add_argument(
@@ -309,6 +342,10 @@ def main(argv: list[str] | None = None) -> int:
     _init_logging()
     args = build_parser().parse_args(argv)
 
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     if args.plan:
         return _run_plan(args)
     if args.eval_gsm8k is not None:
