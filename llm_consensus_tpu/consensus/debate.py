@@ -48,6 +48,15 @@ class DebateConfig:
     # re-scoring of every answer under the engine — judge-model
     # reranking; needs ``engine.score_texts``).
     method: str = "majority"
+    # Prompt templates. None = the built-in generic CoT templates.
+    # Narrow/SFT models answer reliably only in their trained format —
+    # pass the format they know. ``initial_template`` must contain
+    # ``{q}``; ``revise_template`` may use ``{i}``/``{q}``/``{own}``/
+    # ``{peers}`` (all optional: a template that reuses only ``{q}``
+    # turns revision rounds into fresh re-samples, which still
+    # re-votes).
+    initial_template: str | None = None
+    revise_template: str | None = None
 
 
 @dataclass
@@ -107,8 +116,28 @@ def run_debate(
     n = cfg.n_candidates
     rounds: list[DebateRound] = []
     total_tokens = 0
+    initial_t = cfg.initial_template or _INITIAL
+    revise_t = cfg.revise_template or _REVISE
+    # Dry-run BOTH templates now (same fail-fast invariant as the
+    # method checks above): a typo'd placeholder or a literal brace in
+    # a custom format must not surface only at round-2 prompt build,
+    # after an N-candidate device round has already been spent — and an
+    # initial template that drops {q} would debate a question-free
+    # prompt.
+    try:
+        probe = initial_t.format(q=question)
+        revise_t.format(i=0, q=question, own="x", peers="y")
+    except (KeyError, IndexError, ValueError) as e:
+        raise ValueError(
+            f"bad debate template (unknown placeholder or literal "
+            f"brace? escape literals as {{{{...}}}}): {e!r}"
+        ) from e
+    if question not in probe:
+        raise ValueError(
+            "initial_template must embed the question via {q}"
+        )
 
-    prompts = [_INITIAL.format(q=question)] * n
+    prompts = [initial_t.format(q=question)] * n
     answers: list[str] = []
     for r in range(cfg.max_rounds):
         results = engine.generate_texts(
@@ -127,7 +156,7 @@ def run_debate(
             )
         else:  # "rescore" (validated above)
             vote = rescore_vote(
-                engine, _INITIAL.format(q=question), answers, key_fn
+                engine, initial_t.format(q=question), answers, key_fn
             )
         rounds.append(DebateRound(answers=answers, vote=vote))
         # The quorum early-exit always measures HEADCOUNT agreement:
@@ -142,7 +171,7 @@ def run_debate(
             break
         if r + 1 < cfg.max_rounds:
             prompts = [
-                _REVISE.format(
+                revise_t.format(
                     i=i,
                     q=question,
                     own=answers[i],

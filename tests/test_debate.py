@@ -188,3 +188,66 @@ def test_debate_validates_before_generating():
 
     with pytest.raises(AssertionError, match="must not generate"):
         run_debate(MeshEngine(), "q", DebateConfig(method="rescore"))
+
+
+def test_debate_custom_templates_used():
+    """DebateConfig.initial_template/revise_template override the
+    built-in CoT prompts (narrow SFT models answer reliably only in
+    their trained format) — every round's prompts must use them."""
+    from llm_consensus_tpu.consensus.debate import DebateConfig, run_debate
+
+    seen: list[str] = []
+
+    class Echo:
+        mesh = None
+
+        def generate_texts(self, prompts, temperatures=None, seed=0,
+                           max_new_tokens=None):
+            from llm_consensus_tpu.engine.engine import EngineResult
+
+            seen.extend(prompts)
+            # Disagreeing numeric answers: quorum never met -> the
+            # debate must take all rounds through the revise template.
+            return [
+                EngineResult(text=f"#### {i}", num_tokens=2,
+                             logprob=-1.0, token_ids=[])
+                for i in range(len(prompts))
+            ]
+
+    cfg = DebateConfig(
+        n_candidates=4, max_rounds=2, quorum=1.0,
+        initial_template="MYFMT Q={q} A:",
+        revise_template="REVISE Q={q} MINE={own}",
+    )
+    res = run_debate(Echo(), "what?", cfg)
+    assert res.n_rounds == 2
+    assert seen[0] == "MYFMT Q=what? A:"
+    assert seen[4].startswith("REVISE Q=what? MINE=")
+    assert all("panel debate" not in p for p in seen)  # builtin unused
+
+
+def test_debate_bad_templates_fail_fast():
+    """Template problems must surface BEFORE any generation (the same
+    fail-fast invariant as the method checks)."""
+    from llm_consensus_tpu.consensus.debate import DebateConfig, run_debate
+
+    class Exploding:
+        mesh = None
+
+        def generate_texts(self, *a, **k):
+            raise AssertionError("must not generate")
+
+    with pytest.raises(ValueError, match="template"):
+        run_debate(  # typo'd revise placeholder
+            Exploding(), "q",
+            DebateConfig(revise_template="Revise {peer}: {own}"),
+        )
+    with pytest.raises(ValueError, match="embed the question"):
+        run_debate(  # initial template drops {q}
+            Exploding(), "q", DebateConfig(initial_template="Answer:")
+        )
+    with pytest.raises(ValueError, match="template"):
+        run_debate(  # literal JSON brace, unescaped
+            Exploding(), "q",
+            DebateConfig(initial_template='{"answer": {q}}'),
+        )
