@@ -965,10 +965,9 @@ def decode_step_paged(
     # The paged Pallas kernel walks each row's pages through the
     # scalar-prefetched table (only real pages stream to VMEM); the jnp
     # path materializes k_pool[tables] — every row's full padded
-    # sequence — per layer per step. Kernel is the serving hot path on
-    # TPU; sliding-window configs keep the gather path (the kernel has
-    # no window rule yet).
-    use_paged_kernel = cfg.use_pallas and cfg.sliding_window == 0
+    # sequence — per layer per step. Sliding-window configs (Mistral)
+    # apply the same window rule inside the kernel.
+    use_paged_kernel = cfg.use_pallas
 
     def body(carry, layer_in):
         p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
@@ -984,7 +983,8 @@ def decode_step_paged(
             )
 
             attn = paged_decode_attention(
-                q[:, 0], k_pool, v_pool, tables, pos + 1
+                q[:, 0], k_pool, v_pool, tables, pos + 1,
+                window=cfg.sliding_window,
             )[:, None]  # [B, H, D] -> [B, 1, H, D] (seq axis restored)
         else:
             k_seq = k_pool[tables].reshape(

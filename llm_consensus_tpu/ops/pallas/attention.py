@@ -565,6 +565,7 @@ def _paged_decode_kernel(
     acc_ref,  # [G, D] f32 scratch: running numerator
     *,
     scale: float,
+    window: int,
 ):
     """One (row, kv-head, page) program — online softmax across pages.
 
@@ -587,35 +588,48 @@ def _paged_decode_kernel(
         acc_ref[...] = jnp.zeros((g, d), jnp.float32)
 
     valid = len_ref[b]
-    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
-    k = k_ref[0, :, 0, :]  # [pg, D]
-    scores = jax.lax.dot_general(
-        q,
-        k.astype(jnp.float32),
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # [G, pg]
-    slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
-    scores = jnp.where(slot < valid, scores, _NEG_INF)
+    # Pages wholly BEFORE the sliding window contribute exactly nothing
+    # (every slot masked): skip their compute entirely — paired with the
+    # sentinel-page remap in the wrapper's index maps, a long-context
+    # windowed row costs O(window), not O(total length).
+    live = (j + 1) * pg > valid - window if window > 0 else j >= 0
 
-    m_prev = m_ref[...]
-    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-    # A fully-masked page (or row) keeps m at -inf; exp(-inf - -inf)
-    # would be NaN — substitute 0 so p stays 0 for masked slots.
-    m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-    p = jnp.exp(scores - m_safe)  # [G, pg]
-    alpha = jnp.where(
-        m_prev <= _NEG_INF / 2, 0.0, jnp.exp(m_prev - m_safe)
-    )
-    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(
-        p,
-        v_ref[0, :, 0, :].astype(jnp.float32),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [G, D]
-    acc_ref[...] = acc_ref[...] * alpha + pv
-    m_ref[...] = m_new
+    @pl.when(live)
+    def _fold_page():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+        k = k_ref[0, :, 0, :]  # [pg, D]
+        scores = jax.lax.dot_general(
+            q,
+            k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G, pg]
+        slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
+        in_range = slot < valid
+        if window > 0:
+            # Sliding window (Mistral): only the last `window` slots
+            # attend — same rule as ops.attention.decode_attention.
+            in_range &= slot >= valid - window
+        scores = jnp.where(in_range, scores, _NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        # A fully-masked page (or row) keeps m at -inf; exp(-inf - -inf)
+        # would be NaN — substitute 0 so p stays 0 for masked slots.
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(scores - m_safe)  # [G, pg]
+        alpha = jnp.where(
+            m_prev <= _NEG_INF / 2, 0.0, jnp.exp(m_prev - m_safe)
+        )
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p,
+            v_ref[0, :, 0, :].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
 
     @pl.when(j == n_pages - 1)
     def _write():
@@ -629,6 +643,7 @@ def paged_decode_attention(
     v_pool: jnp.ndarray,
     page_table: jnp.ndarray,
     valid_len: jnp.ndarray,
+    window: int = 0,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Decode attention THROUGH the page table — no pool gather.
@@ -644,8 +659,9 @@ def paged_decode_attention(
     the row's OWN pages via the scalar-prefetched table: the BlockSpec
     index map reads ``page_table`` to choose which pool page lands in
     VMEM, so only real pages are streamed and the score tile never
-    touches HBM. SURVEY §7's "ragged/paged decode attention in Pallas"
-    hard part, paged half.
+    touches HBM. ``window`` > 0 applies the sliding-window rule (only
+    the last ``window`` slots attend — Mistral configs). SURVEY §7's
+    "ragged/paged decode attention in Pallas" hard part, paged half.
     """
     b, h, d = q.shape
     n_pages, pg, hkv, _ = k_pool.shape
@@ -661,6 +677,16 @@ def paged_decode_attention(
     tbl = page_table.reshape(-1).astype(jnp.int32)
     lens = valid_len.astype(jnp.int32)
 
+    def _page_map(bi, hi, ji, tbl, lens):
+        page = tbl[bi * p_per + ji]
+        if window > 0:
+            # Pages wholly before the window remap to the sentinel page
+            # 0: consecutive skipped grid steps then request the SAME
+            # block, so their DMAs collapse instead of streaming K/V the
+            # kernel would only mask away (the pl.when skip inside).
+            page = jnp.where((ji + 1) * pg > lens[bi] - window, page, 0)
+        return (page, 0, hi, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page table, valid lengths
         grid=(b, hkv, p_per),
@@ -668,24 +694,8 @@ def paged_decode_attention(
             pl.BlockSpec(
                 (1, 1, g, d), lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)
             ),
-            pl.BlockSpec(
-                (1, pg, 1, d),
-                lambda bi, hi, ji, tbl, lens: (
-                    tbl[bi * p_per + ji],
-                    0,
-                    hi,
-                    0,
-                ),
-            ),
-            pl.BlockSpec(
-                (1, pg, 1, d),
-                lambda bi, hi, ji, tbl, lens: (
-                    tbl[bi * p_per + ji],
-                    0,
-                    hi,
-                    0,
-                ),
-            ),
+            pl.BlockSpec((1, pg, 1, d), _page_map),
+            pl.BlockSpec((1, pg, 1, d), _page_map),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, g, d), lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)
@@ -697,7 +707,7 @@ def paged_decode_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, scale=scale),
+        functools.partial(_paged_decode_kernel, scale=scale, window=window),
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,

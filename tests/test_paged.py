@@ -505,3 +505,61 @@ def test_overlong_prompt_rejected_when_truncation_disabled():
             b.submit("x" * 100)  # ~100 byte tokens > 16-token bucket
     finally:
         b.close()
+
+
+def test_paged_decode_attention_kernel_sliding_window():
+    """window > 0 (Mistral): only the last `window` slots attend — the
+    kernel must match the gather path's windowed mask, including a
+    window that starts mid-page and a row shorter than the window."""
+    from llm_consensus_tpu.ops.attention import decode_attention
+    from llm_consensus_tpu.ops.pallas.attention import paged_decode_attention
+
+    b, h, hkv, d = 2, 4, 2, 128
+    n_pages, pg, p_per = 8, 8, 4
+    q = jax.random.normal(jax.random.PRNGKey(7), (b, h, d), jnp.float32)
+    k_pool = jax.random.normal(jax.random.PRNGKey(8), (n_pages, pg, hkv, d))
+    v_pool = jax.random.normal(jax.random.PRNGKey(9), (n_pages, pg, hkv, d))
+    tables = jnp.asarray([[3, 6, 1, 2], [5, 4, 0, 0]])
+    valid = jnp.asarray([27, 6], jnp.int32)  # window mid-page / short row
+    for window in (10, 4):
+        got = paged_decode_attention(
+            q, k_pool, v_pool, tables, valid, window=window, interpret=True
+        )
+        k_seq = k_pool[tables].reshape(b, p_per * pg, hkv, d)
+        v_seq = v_pool[tables].reshape(b, p_per * pg, hkv, d)
+        want = decode_attention(
+            q[:, None], k_seq, v_seq, valid, window=window
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+            err_msg=f"window={window}",
+        )
+
+
+def test_decode_step_paged_kernel_sliding_window_config():
+    """A sliding-window config (Mistral-style) routed through the paged
+    kernel must match the gather path's logits."""
+    from llm_consensus_tpu.models.transformer import decode_step_paged
+
+    wcfg = CFG.with_(sliding_window=6)
+    cache = PagedKVCache.create(
+        wcfg, n_pages=10, page_size=4, max_seqs=2, pages_per_seq=4
+    )
+    cache = assign_pages(cache, jnp.int32(0), jnp.asarray([2, 5, 7, 9]))
+    cache = assign_pages(cache, jnp.int32(1), jnp.asarray([1, 3, 0, 0]))
+    params = _params()
+    L, hkv, d = wcfg.n_layers, wcfg.n_kv_heads, wcfg.head_dim
+    k_seq = jax.random.normal(jax.random.PRNGKey(10), (L, 8, hkv, d))
+    cache = write_prefill_kv(cache, jnp.int32(0), k_seq, k_seq, jnp.int32(8))
+    cache = write_prefill_kv(
+        cache, jnp.int32(1), k_seq[:, :4], k_seq[:, :4], jnp.int32(3)
+    )
+    toks = jnp.asarray([[11], [23]], jnp.int32)
+    logits_ref, _ = decode_step_paged(wcfg, params, toks, cache)
+    logits_krn, _ = decode_step_paged(
+        wcfg.with_(use_pallas=True), params, toks, cache
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_krn), np.asarray(logits_ref),
+        rtol=2e-4, atol=2e-4,
+    )
