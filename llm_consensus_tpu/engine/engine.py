@@ -177,6 +177,13 @@ class InferenceEngine:
         # Lifetime counters; see stats().
         self._calls = {"generate": 0, "speculative": 0, "stream": 0, "score": 0}
         self._tokens_generated = 0
+        from llm_consensus_tpu.utils.stops import VisibleIdFilter
+
+        # Empty-id-aware tail window for incremental stop checks (memo
+        # persists across generate calls).
+        self._vis_filter = VisibleIdFilter(
+            self.tokenizer, skip_ids=(self.tokenizer.eos_id,)
+        )
         self.mesh = mesh
         self._data_sharding = None
         if mesh is not None:
@@ -369,9 +376,10 @@ class InferenceEngine:
     def _trim_stops(results: list[EngineResult], stop: list[str] | None):
         """Cut each text at the earliest stop occurrence (stop removed).
 
-        ``num_tokens``/``logprob`` keep the device-loop accounting (they
-        include any overshoot past a multi-token stop) — throughput
-        numbers stay honest about what was actually decoded.
+        ``num_tokens``/``logprob`` keep the device-loop accounting here;
+        the chunked multi-token-stop path follows up with
+        :meth:`_exact_stop_accounting` so its reported counts match the
+        device path's stop-token-inclusive accounting exactly.
         """
         if not stop:
             return results
@@ -759,8 +767,10 @@ class InferenceEngine:
         ``generate_stream`` convention) — deterministic per seed, but a
         different stream than the no-stop program. A row whose text
         contains a stop is marked done on device at the next chunk
-        boundary, so ``num_tokens``/``logprob`` stay honest about what
-        was actually decoded (at most one chunk of overshoot)."""
+        boundary; the final :meth:`_exact_stop_accounting` pass then
+        realigns ``num_tokens``/``logprob``/``token_ids`` to the prefix
+        through the stop, so both stop paths report identical
+        accounting (no chunk-granularity overshoot in vote weights)."""
         from llm_consensus_tpu.engine.generate import prefill_into_cache
 
         b, s = tokens_j.shape
@@ -811,6 +821,7 @@ class InferenceEngine:
             lp_sum = np.asarray(lp0, np.float32).copy()
             cols_toks = [toks0[:, None].astype(np.int32)]
             cols_live = [np.ones((b, 1), bool)]
+            cols_lp = [np.asarray(lp0, np.float32)[:, None]]
             stop_hit = np.zeros((b,), bool)
             done = jnp.asarray(done_np)
             if self._data_sharding is not None:
@@ -826,13 +837,25 @@ class InferenceEngine:
             from llm_consensus_tpu.utils.stops import stop_tail_window
 
             win = stop_tail_window(tok_, stop)
+            vis = self._vis_filter
             row_ids: list[list[int]] = [
                 [] if done_np[r] else [int(toks0[r])] for r in range(n_real)
             ]
 
             def _row_stopped(r: int) -> bool:
-                text = tok_.decode(row_ids[r][-win:])
-                return any(x in text for x in stop)
+                # Window check first (cheap, every chunk); a hit is
+                # confirmed against the full decoded row before marking
+                # it done — a merge-based tokenizer can decode the tail
+                # window differently from the full text at the window
+                # head, and a false positive here would silently
+                # truncate a row that _trim_stops then finds no stop
+                # in. Full decode runs only on candidate hits.
+                ids = row_ids[r]
+                text = tok_.decode(vis.visible_tail(ids, win))
+                if not any(x in text for x in stop):
+                    return False
+                full = tok_.decode(ids)
+                return any(x in full for x in stop)
 
             while produced < mnt:
                 active = [
@@ -864,7 +887,9 @@ class InferenceEngine:
                 cols_live.append(live_np)
                 # Per-step logprobs, truncated to the consumed prefix —
                 # tail-chunk overshoot must not inflate the sum.
-                lp_sum += np.asarray(lp, np.float32)[:, :k].sum(axis=1)
+                lp_np = np.asarray(lp, np.float32)[:, :k]
+                cols_lp.append(lp_np)
+                lp_sum += lp_np.sum(axis=1)
                 produced += k
                 done_np = np.asarray(done).copy()
                 for r in active:
@@ -884,12 +909,63 @@ class InferenceEngine:
 
         tokens_arr = np.concatenate(cols_toks, axis=1)
         live_arr = np.concatenate(cols_live, axis=1)
+        lp_arr = np.concatenate(cols_lp, axis=1)
         out = GenerateOutput(
             tokens=jnp.asarray(tokens_arr),
             num_tokens=jnp.asarray(live_arr.sum(axis=1).astype(np.int32)),
             logprob_sum=jnp.asarray(lp_sum),
         )
-        return self._trim_stops(self._collect(out, n_real), stop)
+        results = self._trim_stops(self._collect(out, n_real), stop)
+        return self._exact_stop_accounting(results, tokens_arr, lp_arr, stop)
+
+    def _exact_stop_accounting(
+        self, results, toks_np, lp_np, stop
+    ) -> list[EngineResult]:
+        """Align the chunked multi-token-stop path's accounting with
+        the device single-token-stop path: ``num_tokens`` / ``logprob``
+        / ``token_ids`` cover exactly the prefix through the first
+        complete stop occurrence (the stop's own tokens counted, like
+        EOS) instead of including up to one ``stop_check_chunk`` of
+        overshoot. Without this, the SAME stop reported different
+        logit_pool/rescore vote weights depending on whether it
+        tokenized to one id (device path, exact) or several (chunked
+        path) — aggregation weights must not depend on tokenizer
+        granularity. The prefix search assumes decoded-prefix
+        containment is monotone in token count (exact for byte-level
+        tokenizers; merge-based boundary effects can shift the cut by
+        a token, never the text, which was already trimmed exactly).
+        """
+        from llm_consensus_tpu.utils.stops import earliest_stop_cut
+
+        eos = self.tokenizer.eos_id
+        for i, r in enumerate(results):
+            n = r.num_tokens
+            if n <= 1:
+                continue
+
+            def ids(m: int) -> list[int]:
+                # Mirrors _collect's id construction (eos excluded) —
+                # one predicate, shared by the probe and the result.
+                return [int(t) for t in toks_np[i, :m] if int(t) != eos]
+
+            if earliest_stop_cut(self.tokenizer.decode(ids(n)), stop) < 0:
+                continue
+            lo, hi = 1, n
+            while lo < hi:
+                mid = (lo + hi) // 2
+                pref = self.tokenizer.decode(ids(mid))
+                if earliest_stop_cut(pref, stop) >= 0:
+                    hi = mid
+                else:
+                    lo = mid + 1
+            if lo < n:
+                # Keep the engine-wide generated-token counter honest
+                # too (it was bumped with the overshoot included).
+                self._tokens_generated -= n - lo
+                r.num_tokens = lo
+                r.logprob = float(lp_np[i, :lo].sum())
+                r.token_ids = ids(lo)
+        return results
 
     def generate_stream(
         self,

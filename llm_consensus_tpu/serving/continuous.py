@@ -45,7 +45,11 @@ from llm_consensus_tpu.engine.sampler import (
     sample_token_per_request,
 )
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
-from llm_consensus_tpu.utils.stops import earliest_stop_cut, stop_tail_window
+from llm_consensus_tpu.utils.stops import (
+    VisibleIdFilter,
+    earliest_stop_cut,
+    stop_tail_window,
+)
 from llm_consensus_tpu.models.cache import KVCache
 from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.models.paged_cache import (
@@ -148,6 +152,9 @@ class ContinuousBatcher:
         self._completed = 0
         self._generated_tokens = 0
         self._decode_steps = 0
+        self._vis_filter = VisibleIdFilter(
+            self.tokenizer, skip_ids=(self.tokenizer.eos_id,)
+        )
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._work = threading.Event()
@@ -404,18 +411,27 @@ class ContinuousBatcher:
         stop's token length plus slack for a stop/multibyte sequence
         straddling the window head — so per-request stop checking stays
         O(T·window), not O(T²), on the thread that paces device steps.
+        Empty-decoding ids are filtered out of the window slice so the
+        window counts visible tokens, and a window hit is CONFIRMED
+        against the full decoded text before retiring the row: a
+        merge-based tokenizer can decode a tail window differently from
+        the full text at the window head, and retiring on such a false
+        positive would truncate output that the final
+        ``earliest_stop_cut`` pass then finds no stop in. The full
+        decode runs only on candidate hits, so the cost stays
+        amortized.
         """
         stops = slot.request.stop
         if not stops:
             return False
-        w = slot.request.stop_window
-        ids = [
-            t
-            for t in slot.generated[-2 * w :]
-            if t != self.tokenizer.eos_id
-        ][-w:]
+        ids = self._vis_filter.visible_tail(
+            slot.generated, slot.request.stop_window
+        )
         text = self.tokenizer.decode(ids)
-        return any(s in text for s in stops)
+        if not any(s in text for s in stops):
+            return False
+        full = self._decoded_text(slot)
+        return any(s in full for s in stops)
 
     def _retire(self, idx: int) -> None:
         slot = self._slots[idx]

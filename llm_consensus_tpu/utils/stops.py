@@ -18,7 +18,7 @@ duplicated inline copies would silently disagree.
 
 from __future__ import annotations
 
-from typing import Iterable
+from typing import Iterable, Sequence
 
 
 def earliest_stop_cut(text: str, stops: Iterable[str]) -> int:
@@ -30,6 +30,61 @@ def earliest_stop_cut(text: str, stops: Iterable[str]) -> int:
     )
 
 
+class VisibleIdFilter:
+    """Sizes the stop-check tail window by VISIBLE token count.
+
+    Incremental stop checks decode only a tail WINDOW of token ids
+    (see :func:`stop_tail_window`); that window math assumes every id
+    decodes to >=1 byte. Tokenizers with ids that decode to the empty
+    string IN ISOLATION (special pieces, byte-fallback fragments) would
+    stretch a stop across more than ``window`` tokens and the check
+    would miss it — no wrong text (the final trim is exact) but the
+    early exit the incremental check exists for is lost. This filter
+    extends the tail slice until it holds ``window`` ids that decode to
+    >=1 character on their own, WITHOUT dropping the empty-decoding ids
+    from the returned slice: a byte-fallback fragment decodes to
+    nothing alone but contributes its bytes in context, so the slice
+    must stay contiguous for the window decode to assemble multi-piece
+    characters. Only ``skip_ids`` (EOS — never mid-stream) are removed.
+
+    Per-id emptiness is memoized — steady-state cost is dict lookups,
+    not decodes. The backward scan is bounded at ``8 * window`` raw ids
+    per check: if more than 7/8 of the tail decodes to nothing the
+    window may still under-cover (strictly rarer than the unfiltered
+    check, and the final full-text trim still guarantees exact output).
+    """
+
+    def __init__(self, tokenizer, skip_ids: Iterable[int] = ()):
+        self._tok = tokenizer
+        self._skip = frozenset(int(i) for i in skip_ids)
+        self._empty: dict[int, bool] = {}
+
+    def _is_empty(self, t: int) -> bool:
+        e = self._empty.get(t)
+        if e is None:
+            e = self._tok.decode([t]) == ""
+            self._empty[t] = e
+        return e
+
+    def visible_tail(self, ids: Sequence[int], window: int) -> list[int]:
+        """Contiguous tail of ``ids`` containing ``window`` ids that
+        decode to >=1 character (``skip_ids`` removed), scanning back
+        at most ``8 * window`` ids."""
+        if window <= 0:
+            return []
+        visible = 0
+        span = 0
+        for t in reversed(ids[-8 * window :]):
+            span += 1
+            t = int(t)
+            if t in self._skip or self._is_empty(t):
+                continue
+            visible += 1
+            if visible >= window:
+                break
+        return [int(t) for t in ids[-span:] if int(t) not in self._skip]
+
+
 def stop_tail_window(tokenizer, stops: Iterable[str], slack: int = 8) -> int:
     """Tail-token window width for incremental stop checks.
 
@@ -37,8 +92,10 @@ def stop_tail_window(tokenizer, stops: Iterable[str], slack: int = 8) -> int:
     emitting the stop text — not the count the tokenizer's own greedy
     encoding uses: a merge-based tokenizer may encode "\\n\\n---" as 2
     ids, but a model can emit the same characters one fine-grained
-    token at a time. Every token decodes to at least one byte, so
-    ``len(stop.encode("utf-8"))`` bounds the span for any tokenizer;
+    token at a time. Every VISIBLE token decodes to at least one byte
+    (callers filter empty-decoding ids out of the window slice with
+    :class:`VisibleIdFilter`), so ``len(stop.encode("utf-8"))`` bounds
+    the span for any tokenizer;
     the encoded length is kept as a floor for exotic multi-char-per-
     byte cases, and ``slack`` covers a multibyte character (or another
     stop's prefix) straddling the window head. Compute ONCE per

@@ -1217,3 +1217,64 @@ def test_per_request_sampler_matches_static_on_kth_ties():
             seen[r],
             allowed[r],
         )
+
+
+def test_chunked_stop_accounting_matches_device_path(tiny):
+    """r4 advisor: the chunked multi-token-stop path must report the
+    same num_tokens/logprob accounting as the device single-token-stop
+    path — the minimal token prefix whose decode contains the stop
+    (stop tokens counted like EOS), no stop_check_chunk overshoot.
+    test-tiny's vocab exceeds the byte range, so the random model
+    interleaves empty-decoding ids — the expected count is computed
+    from the free run's token stream, not from char arithmetic.
+    """
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(16,), batch_buckets=(1,), max_new_tokens=32,
+            stop_check_chunk=16,
+        ),
+    )
+    free = eng.generate_texts(["hello there"])[0]
+    if len(free.text) < 4 or free.num_tokens < 5:
+        pytest.skip("output too short to split")
+    stop = free.text[1:3]  # two byte tokens -> chunked path
+    got = eng.generate_texts(["hello there"], stop=[stop])[0]
+    assert got.text == free.text[:1]
+    # Greedy decode is deterministic, so got's tokens are a prefix of
+    # free's; the exact cut is the minimal k whose decode has the stop.
+    k = next(
+        k
+        for k in range(1, free.num_tokens + 1)
+        if stop in eng.tokenizer.decode(free.token_ids[:k])
+    )
+    assert k < free.num_tokens  # overshoot was possible -> test is real
+    assert got.num_tokens == k
+    assert got.token_ids == free.token_ids[:k]
+    # logprob covers exactly those k tokens: a greedy no-stop run
+    # capped at k new tokens decodes the same prefix and sums the same
+    # per-token logprobs.
+    want = eng.generate_texts(["hello there"], max_new_tokens=k)[0]
+    assert want.token_ids == free.token_ids[:k]
+    np.testing.assert_allclose(got.logprob, want.logprob, rtol=1e-4)
+
+
+def test_chunked_stop_engine_token_counter_honest(tiny):
+    """The engine-wide generated-token counter must match the reported
+    (realigned) num_tokens — _exact_stop_accounting subtracts the
+    overshoot _collect counted."""
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(
+            seq_buckets=(16,), batch_buckets=(1,), max_new_tokens=32,
+            stop_check_chunk=16,
+        ),
+    )
+    free = eng.generate_texts(["hello there"])[0]
+    if len(free.text) < 4:
+        pytest.skip("output too short to split")
+    base = eng.stats()["tokens_generated"]
+    got = eng.generate_texts(["hello there"], stop=[free.text[1:3]])[0]
+    assert eng.stats()["tokens_generated"] - base == got.num_tokens

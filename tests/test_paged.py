@@ -563,3 +563,53 @@ def test_decode_step_paged_kernel_sliding_window_config():
         np.asarray(logits_krn), np.asarray(logits_ref),
         rtol=2e-4, atol=2e-4,
     )
+
+
+def test_hit_stop_confirms_window_hit_against_full_text():
+    """r4 advisor: a merge-based tokenizer can decode a TAIL WINDOW
+    differently from the full text at the window head, so a window-only
+    stop check can false-positive and retire the row early — output
+    silently truncated while the final earliest_stop_cut finds no stop.
+    _hit_stop must confirm candidate hits against the full decode."""
+    from types import SimpleNamespace
+
+    from llm_consensus_tpu.serving.continuous import ContinuousBatcher
+    from llm_consensus_tpu.utils.stops import VisibleIdFilter
+
+    class MergeTok:
+        """Context-sensitive decode: id 2 alone is "b", but after id 1
+        the pair [1, 2] merges to "aX" (no "b" anywhere)."""
+
+        eos_id = 99
+
+        def decode(self, ids):
+            out = []
+            prev = None
+            for t in ids:
+                if prev == 1 and t == 2:
+                    out[-1] = "aX"
+                else:
+                    out.append({1: "a", 2: "b"}.get(t, "?"))
+                prev = t
+            return "".join(out)
+
+    tok = MergeTok()
+    host = SimpleNamespace(
+        tokenizer=tok,
+        _vis_filter=VisibleIdFilter(tok, skip_ids=(tok.eos_id,)),
+    )
+    host._decoded_text = lambda s: ContinuousBatcher._decoded_text(host, s)
+    slot = SimpleNamespace(
+        generated=[1, 2],
+        request=SimpleNamespace(stop=("b",), stop_window=1),
+    )
+    # Window [2] decodes "b" (candidate hit); full text "aX" has no
+    # stop -> must NOT retire.
+    assert not ContinuousBatcher._hit_stop(host, slot)
+    # A genuine stop (newest token decodes "b" in the full text too)
+    # still hits.
+    slot2 = SimpleNamespace(
+        generated=[1, 2, 2],
+        request=SimpleNamespace(stop=("b",), stop_window=1),
+    )
+    assert ContinuousBatcher._hit_stop(host, slot2)

@@ -124,3 +124,77 @@ def test_earliest_stop_cut_and_tail_window():
     # Even though the tokenizer encodes the stop as ONE id, a model can
     # emit it one byte-ish token at a time: the byte length must win.
     assert stop_tail_window(MergeTok(), ["Final answer"]) == 12 + 8
+
+
+def test_visible_id_filter_sizes_window_by_visible_count():
+    """VisibleIdFilter: the tail window counts ids that actually decode
+    to characters — empty-decoding ids (special/byte-fallback pieces)
+    and skip_ids (EOS) must not consume window slots, or a stop
+    stretched across them escapes the incremental check (r4 advisor).
+    The returned slice stays CONTIGUOUS (empty ids kept, only skip_ids
+    removed): byte-fallback fragments decode to nothing alone but
+    contribute bytes in context."""
+    from llm_consensus_tpu.utils.stops import VisibleIdFilter
+
+    class Tok:
+        """ids >= 100 decode to nothing (special pieces); 99 is EOS."""
+
+        eos_id = 99
+
+        def __init__(self):
+            self.decode_calls = 0
+
+        def decode(self, ids):
+            self.decode_calls += 1
+            return "".join(chr(ord("a") + i) for i in ids if i < 99)
+
+    tok = Tok()
+    f = VisibleIdFilter(tok, skip_ids=(tok.eos_id,))
+    # Window of 2 over a tail full of empty/skip ids extends past them
+    # to reach 2 visible tokens; empty ids stay in the slice, EOS out.
+    assert f.visible_tail([0, 100, 101, 99, 1], 2) == [0, 100, 101, 1]
+    assert f.visible_tail([], 3) == []
+    assert f.visible_tail([0, 1, 2], 0) == []
+    # Exactly `window` visible ids bound the slice from the left.
+    assert f.visible_tail([3, 4, 0, 100, 1], 2) == [0, 100, 1]
+    # Memoized: a second pass over the same ids does no new decodes.
+    before = tok.decode_calls
+    f.visible_tail([0, 100, 101, 99, 1], 2)
+    assert tok.decode_calls == before
+    # Scan bound: at most 8 * window raw ids are examined, so a
+    # pathological all-empty tail degrades (under-covers) instead of
+    # scanning the whole history.
+    ids = [5] + [100] * 100 + [6]
+    assert f.visible_tail(ids, 2) == [100] * 15 + [6]
+
+
+def test_visible_id_filter_keeps_fragment_assembly():
+    """Contiguity matters: a stop character split across byte-fallback
+    fragments (each decoding to "" alone) must still assemble in the
+    window decode — dropping empty ids would make the stop invisible
+    to the incremental check."""
+    from llm_consensus_tpu.utils.stops import VisibleIdFilter
+
+    class FragTok:
+        """50 and 51 decode to nothing alone; the PAIR decodes to the
+        em dash. Other ids are ascii letters."""
+
+        eos_id = 99
+
+        def decode(self, ids):
+            out, i = [], 0
+            while i < len(ids):
+                if ids[i] == 50 and i + 1 < len(ids) and ids[i + 1] == 51:
+                    out.append("—")
+                    i += 2
+                    continue
+                if ids[i] not in (50, 51, 99):
+                    out.append(chr(ord("a") + ids[i]))
+                i += 1
+            return "".join(out)
+
+    tok = FragTok()
+    f = VisibleIdFilter(tok, skip_ids=(tok.eos_id,))
+    tail = f.visible_tail([0, 1, 50, 51, 2], 3)
+    assert tail == [0, 1, 50, 51, 2]
+    assert "—" in tok.decode(tail)
