@@ -46,9 +46,25 @@ from llm_consensus_tpu.training.loop import LoopConfig, run_training
 from llm_consensus_tpu.training.train import TrainConfig
 
 
+def _splits(args):
+    """(eval_problems, holdout_signatures) for the selected task."""
+    if args.task == "arith2":
+        from llm_consensus_tpu.eval.arith2 import eval_problems
+
+        return eval_problems(args.n_problems, seed=args.eval_seed)
+    return eval_split(args.n_problems, seed=args.eval_seed)
+
+
 def train(args, cfg, tok) -> None:
-    _, holdout = eval_split(args.n_problems, seed=args.eval_seed)
-    examples = build_sft_examples(tok, exclude=holdout, limit=args.limit)
+    _, holdout = _splits(args)
+    if args.task == "arith2":
+        from llm_consensus_tpu.eval.arith2 import (
+            build_sft_examples as build2,
+        )
+
+        examples = build2(tok, args.n_train, exclude=holdout)
+    else:
+        examples = build_sft_examples(tok, exclude=holdout, limit=args.limit)
     loader = SftBatchLoader(
         examples, args.batch, args.seq, seed=1, pad_id=tok.pad_id
     )
@@ -107,7 +123,7 @@ def load_engine(args, cfg, tok) -> InferenceEngine:
 
 
 def evaluate(args, engine) -> dict:
-    problems, _ = eval_split(args.n_problems, seed=args.eval_seed)
+    problems, _ = _splits(args)
     rows = []
     for n in args.ns:
         rep = evaluate_self_consistency(
@@ -127,6 +143,7 @@ def evaluate(args, engine) -> dict:
         )
     return {
         "model": engine.cfg.name,
+        "task": args.task,
         "n_problems": args.n_problems,
         "temperature": args.temperature,
         "device": jax.devices()[0].platform,
@@ -136,10 +153,38 @@ def evaluate(args, engine) -> dict:
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--model", default="arith-14m")
+    p.add_argument(
+        "--task",
+        default="arith",
+        choices=("arith", "arith2"),
+        help="arith: single-template (a+b)*c (the round-4 loop); "
+        "arith2: multi-template 2-4-step chains with distractors "
+        "(eval/arith2.py) — pair with --model arith-25m, --seq 704",
+    )
+    p.add_argument(
+        "--model",
+        default="",
+        help="'' = per-task default (arith-14m for arith, arith-25m "
+        "for arith2 — the 512-context arith-14m truncates arith2's "
+        "~650-byte examples)",
+    )
+    p.add_argument(
+        "--n-train",
+        type=int,
+        default=60000,
+        help="arith2 only: SFT examples to sample (the chain space is "
+        "effectively unbounded, unlike arith's 27,848 triples)",
+    )
     p.add_argument("--steps", type=int, default=6000)
     p.add_argument("--batch", type=int, default=32)
-    p.add_argument("--seq", type=int, default=384)
+    p.add_argument(
+        "--seq",
+        type=int,
+        default=0,
+        help="0 = per-task default (384 for arith, 704 for arith2; a "
+        "too-short seq would silently cut the CoT + '####' answer "
+        "off the training pairs)",
+    )
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--limit", type=int, default=0, help="cap SFT examples")
     p.add_argument("--ckpt-dir", default="runs/arith14m")
@@ -148,7 +193,13 @@ def main() -> int:
     p.add_argument("--eval-seed", type=int, default=0)
     p.add_argument("--ns", type=int, nargs="+", default=[1, 8, 32])
     p.add_argument("--temperature", type=float, default=0.7)
-    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument(
+        "--max-new-tokens",
+        type=int,
+        default=0,
+        help="0 = per-task default (64 for arith's 2-step CoT, 112 for "
+        "arith2's up-to-4-step CoT)",
+    )
     p.add_argument("--eval-only", action="store_true")
     p.add_argument("--train-only", action="store_true")
     p.add_argument("--report", default="")
@@ -161,8 +212,20 @@ def main() -> int:
     args = p.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if not args.max_new_tokens:
+        args.max_new_tokens = 112 if args.task == "arith2" else 64
+    if not args.model:
+        args.model = "arith-25m" if args.task == "arith2" else "arith-14m"
+    if not args.seq:
+        args.seq = 704 if args.task == "arith2" else 384
 
     cfg = get_config(args.model)
+    if args.task == "arith2" and cfg.max_seq_len < 640:
+        raise SystemExit(
+            f"--task arith2 needs max_seq_len >= 640 (prompts+CoT reach "
+            f"~650 bytes); {cfg.name} has {cfg.max_seq_len}. Use "
+            f"--model arith-25m."
+        )
     tok = ByteTokenizer()
     if not args.eval_only:
         train(args, cfg, tok)

@@ -843,19 +843,13 @@ class InferenceEngine:
             ]
 
             def _row_stopped(r: int) -> bool:
-                # Window check first (cheap, every chunk); a hit is
-                # confirmed against the full decoded row before marking
-                # it done — a merge-based tokenizer can decode the tail
-                # window differently from the full text at the window
-                # head, and a false positive here would silently
-                # truncate a row that _trim_stops then finds no stop
-                # in. Full decode runs only on candidate hits.
+                # Shared window-then-confirm shape (stops.py): a false
+                # positive here would silently truncate a row that
+                # _trim_stops then finds no stop in.
                 ids = row_ids[r]
-                text = tok_.decode(vis.visible_tail(ids, win))
-                if not any(x in text for x in stop):
-                    return False
-                full = tok_.decode(ids)
-                return any(x in full for x in stop)
+                return vis.confirmed_stop_hit(
+                    ids, stop, win, lambda: tok_.decode(ids)
+                )
 
             while produced < mnt:
                 active = [

@@ -1,0 +1,318 @@
+"""Multi-template, multi-step arithmetic corpus (the hard accuracy task).
+
+Round 4's accuracy evidence used ONE sentence frame computing (a+b)*c
+(``eval/arith.py``) — a converged model saturates EM at 1.000 and N=1
+already wins, so self-consistency had nothing to move. This corpus is
+the non-trivial successor (VERDICT round-4, item 2): GSM8K-*style*
+multi-step word problems, built offline (the env is zero-egress), hard
+enough that a converged small model sits meaningfully below EM 1.0 on
+held-out problems — the regime where Wang-et-al self-consistency
+(majority vote over sampled chains) actually pays.
+
+Problem = a **chain** of 2-4 arithmetic steps over a running value::
+
+    v0 --(op1 b1)--> v1 --(op2 b2)--> ... --> answer
+
+rendered through one of SIX narrative frames (different protagonist,
+entity, and per-operation phrasing), with 1-2 **distractor sentences**
+carrying numbers that must NOT enter the computation. The completion is
+a step-by-step chain of thought ending in the ``#### <answer>`` marker
+the EM extractor keys on (``consensus/voting.extract_final_number``)::
+
+    " 17 + 24 = 41. 41 * 3 = 123. 123 - 38 = 85. #### 85"
+
+Held-out split is at the CHAIN level: a chain signature
+``(v0, ops, operands)`` appearing in the eval set is excluded from
+training regardless of which frame renders it, so EM measures
+generalization to unseen computations, not memorization of eval items.
+
+The reference outsources answering to a remote LLM (``src/main.rs:82-86``)
+and has no evaluation at all (SURVEY.md §4/§6); this corpus exists so the
+rebuilt stack's accuracy claims come from a model it trained itself.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from llm_consensus_tpu.eval.gsm8k import Problem
+
+# ---------------------------------------------------------------------------
+# Chains
+
+_OPS = ("+", "-", "*", "/")
+
+
+@dataclass(frozen=True)
+class Chain:
+    """A multi-step computation: start value + (op, operand) steps."""
+
+    v0: int
+    ops: tuple[str, ...]
+    operands: tuple[int, ...]
+
+    @property
+    def signature(self) -> tuple:
+        return (self.v0, self.ops, self.operands)
+
+    @property
+    def values(self) -> list[int]:
+        """All intermediate values [v0, v1, ..., answer]."""
+        vals = [self.v0]
+        for op, b in zip(self.ops, self.operands):
+            v = vals[-1]
+            if op == "+":
+                vals.append(v + b)
+            elif op == "-":
+                vals.append(v - b)
+            elif op == "*":
+                vals.append(v * b)
+            elif op == "/":
+                if v % b:
+                    raise ValueError(f"inexact division {v}/{b}")
+                vals.append(v // b)
+            else:
+                raise ValueError(f"unknown op {op!r}")
+        return vals
+
+    @property
+    def answer(self) -> int:
+        return self.values[-1]
+
+
+def sample_chain(rng: random.Random, n_steps: int | None = None) -> Chain:
+    """Draw a chain with all intermediates in [2, 999].
+
+    Steps: 2-4 (uniform). Operands: add/sub in [2, 99], mul in [2, 9]
+    (result bounded), div a true divisor in [2, 9]. Ops are drawn per
+    step from whichever of the four are feasible at the current value,
+    so every chain is exact-arithmetic by construction.
+    """
+    k = n_steps or rng.randint(2, 4)
+    for _ in range(64):  # rejection loop (rarely needed)
+        v0 = rng.randint(3, 99)
+        ops: list[str] = []
+        operands: list[int] = []
+        v = v0
+        ok = True
+        for _ in range(k):
+            feasible = []
+            if v + 2 <= 999:
+                feasible.append("+")
+            if v - 2 >= 2:
+                feasible.append("-")
+            if v * 2 <= 999:
+                feasible.append("*")
+            divisors = [d for d in range(2, 10) if v % d == 0 and v // d >= 2]
+            if divisors:
+                feasible.append("/")
+            if not feasible:
+                ok = False
+                break
+            op = rng.choice(feasible)
+            if op == "+":
+                b = rng.randint(2, min(99, 999 - v))
+                v = v + b
+            elif op == "-":
+                b = rng.randint(2, min(99, v - 2))
+                v = v - b
+            elif op == "*":
+                b = rng.randint(2, min(9, 999 // v))
+                v = v * b
+            else:
+                b = rng.choice(divisors)
+                v = v // b
+            ops.append(op)
+            operands.append(b)
+        if ok:
+            return Chain(v0, tuple(ops), tuple(operands))
+    raise RuntimeError("could not sample a feasible chain")
+
+
+# ---------------------------------------------------------------------------
+# Narrative frames
+#
+# Each frame: protagonist + entity + one phrasing per op + distractor
+# sentence templates. Six frames x varied phrasings = the multi-template
+# surface diversity round 4 lacked. `{b}` is the step operand; `{d}` a
+# distractor value the solution must ignore.
+
+_FRAMES: list[dict] = [
+    {
+        "start": "Maya's basket holds {v0} apples.",
+        "+": "She picks {b} more from the orchard.",
+        "-": "She hands {b} to her neighbor.",
+        "*": "A festival order multiplies her total by {b}.",
+        "/": "She packs them into {b} equal crates and keeps one crate.",
+        "q": "How many apples does Maya have at the end?",
+        "d": [
+            "Her orchard ladder is {d} feet tall.",
+            "She has been picking for {d} minutes.",
+            "Her neighbor lives {d} steps away.",
+        ],
+    },
+    {
+        "start": "Liam's jar contains {v0} marbles.",
+        "+": "He wins {b} more at recess.",
+        "-": "He trades away {b} of them.",
+        "*": "A collector's swap multiplies his total by {b}.",
+        "/": "He splits them into {b} equal bags and keeps a single bag.",
+        "q": "How many marbles does Liam have at the end?",
+        "d": [
+            "His jar weighs {d} grams when empty.",
+            "Recess lasts {d} minutes.",
+            "He is {d} years old.",
+        ],
+    },
+    {
+        "start": "The library shelf starts with {v0} books.",
+        "+": "A donation adds {b} books.",
+        "-": "Readers borrow {b} books.",
+        "*": "A merger with another branch multiplies the count by {b}.",
+        "/": "The books are divided into {b} equal stacks and only one "
+        "stack stays on the shelf.",
+        "q": "How many books are on the shelf at the end?",
+        "d": [
+            "The shelf is {d} inches wide.",
+            "The library opened {d} years ago.",
+            "There are {d} chairs in the reading room.",
+        ],
+    },
+    {
+        "start": "Priya's pouch has {v0} coins.",
+        "+": "She earns {b} more doing chores.",
+        "-": "She spends {b} at the fair.",
+        "*": "A lucky game multiplies her coins by {b}.",
+        "/": "She shares them into {b} equal piles and keeps one pile.",
+        "q": "How many coins does Priya have at the end?",
+        "d": [
+            "The fair ticket line had {d} people.",
+            "Her pouch was a gift from {d} friends.",
+            "The fair runs for {d} days.",
+        ],
+    },
+    {
+        "start": "The farmer collects {v0} eggs at dawn.",
+        "+": "The afternoon coop yields {b} more.",
+        "-": "The market sells {b} of them.",
+        "*": "A wholesale contract multiplies the count by {b}.",
+        "/": "The eggs are boxed into {b} equal cartons and one carton "
+        "is kept.",
+        "q": "How many eggs does the farmer have at the end?",
+        "d": [
+            "The coop is {d} meters from the house.",
+            "The farm has {d} hens.",
+            "Dawn broke at {d} minutes past five.",
+        ],
+    },
+    {
+        "start": "Noah's drawer holds {v0} raffle tickets.",
+        "+": "He buys {b} more at the gate.",
+        "-": "He gives {b} to his cousins.",
+        "*": "A bonus round multiplies his tickets by {b}.",
+        "/": "He sorts them into {b} equal envelopes and keeps just one "
+        "envelope.",
+        "q": "How many raffle tickets does Noah have at the end?",
+        "d": [
+            "The raffle drum spins {d} times.",
+            "The gate opened {d} minutes early.",
+            "His cousin's house is {d} blocks away.",
+        ],
+    },
+]
+
+N_FRAMES = len(_FRAMES)
+
+
+def render_question(
+    chain: Chain,
+    frame_idx: int,
+    rng: random.Random,
+    n_distractors: int | None = None,
+) -> str:
+    """Render a chain through a frame, weaving in distractor sentences.
+
+    Distractor values are drawn from the operand range ([2, 99]) so they
+    are confusable with real quantities; their sentences are inserted at
+    random positions among the step sentences (never before the start
+    sentence, so the initial quantity stays first).
+    """
+    f = _FRAMES[frame_idx % N_FRAMES]
+    nd = rng.randint(1, 2) if n_distractors is None else n_distractors
+    sents = [f["start"].format(v0=chain.v0)]
+    for op, b in zip(chain.ops, chain.operands):
+        sents.append(f[op].format(b=b))
+    for tmpl in rng.sample(f["d"], min(nd, len(f["d"]))):
+        pos = rng.randint(1, len(sents))
+        sents.insert(pos, tmpl.format(d=rng.randint(2, 99)))
+    return " ".join(sents) + " " + f["q"]
+
+
+def render_completion(chain: Chain) -> str:
+    """Step-by-step CoT ending in the ``#### <answer>`` marker."""
+    vals = chain.values
+    parts = []
+    for i, (op, b) in enumerate(zip(chain.ops, chain.operands)):
+        parts.append(f"{vals[i]} {op} {b} = {vals[i + 1]}.")
+    return " " + " ".join(parts) + f" #### {chain.answer}"
+
+
+# ---------------------------------------------------------------------------
+# Splits
+
+def eval_problems(
+    n: int, seed: int = 0
+) -> tuple[list[Problem], set[tuple]]:
+    """Deterministic eval set + its chain signatures (training holdout).
+
+    Frames rotate round-robin so every frame is evaluated; distractor
+    count/placement and the chains themselves come from the seeded rng.
+    """
+    rng = random.Random(seed)
+    problems, sigs = [], set()
+    while len(problems) < n:
+        chain = sample_chain(rng)
+        if chain.signature in sigs:
+            continue
+        q = render_question(chain, len(problems) % N_FRAMES, rng)
+        problems.append(Problem(question=q, answer=f"#### {chain.answer}"))
+        sigs.add(chain.signature)
+    return problems, sigs
+
+
+def build_sft_examples(
+    tokenizer,
+    n_examples: int,
+    *,
+    exclude: set[tuple] | None = None,
+    seed: int = 1,
+    prompt_template: str | None = None,
+) -> list[tuple[list[int], list[int]]]:
+    """Tokenized (prompt_ids, completion_ids) SFT pairs.
+
+    Chains whose signature is in ``exclude`` (the eval holdout) are
+    skipped. Prompts use the SAME template ``evaluate_self_consistency``
+    sends (``gsm8k._PROMPT``) so train and eval token streams agree
+    byte-for-byte; completions carry a trailing EOS so the trained model
+    terminates its answers.
+    """
+    from llm_consensus_tpu.eval.gsm8k import _PROMPT
+
+    template = prompt_template or _PROMPT
+    exclude = exclude or set()
+    rng = random.Random(seed)
+    out = []
+    while len(out) < n_examples:
+        chain = sample_chain(rng)
+        if chain.signature in exclude:
+            continue
+        q = render_question(chain, rng.randrange(N_FRAMES), rng)
+        prompt = template.format(q=q)
+        completion = render_completion(chain)
+        p_ids = tokenizer.encode(prompt)
+        c_ids = tokenizer.encode(completion, add_bos=False) + [
+            tokenizer.eos_id
+        ]
+        out.append((p_ids, c_ids))
+    return out

@@ -168,6 +168,42 @@ PRESETS: dict[str, ModelConfig] = {
         d_ff=1536,
         max_seq_len=512,
     ),
+    # ~25M byte-level model (6 x (4*512^2 + 3*512*2048) = 25.2M
+    # non-embedding) for the MULTI-STEP accuracy loop (eval/arith2.py:
+    # 2-4 chained ops, 6 narrative frames, distractor quantities).
+    # Bigger than arith-14m because the task is genuinely harder, and
+    # max_seq_len 768 because multi-step prompts+CoT reach ~650 bytes
+    # (arith-14m's 512 truncates them).
+    "arith-25m": ModelConfig(
+        name="arith-25m",
+        vocab_size=384,
+        d_model=512,
+        n_layers=6,
+        n_heads=8,
+        n_kv_heads=8,
+        d_ff=2048,
+        max_seq_len=768,
+    ),
+    # ~0.94B-total-param MoE sized to run on ONE chip (VERDICT r4 item
+    # 5: no MoE had ever touched real silicon — Mixtral-8x7B needs an
+    # expert>=4 mesh, PERF.md). 4 experts top-2, Mixtral-style routing
+    # and capacity bound; bf16 weights ~1.9 GiB, int8 ~0.95 GiB, so
+    # decode at N=64 fits v5e HBM with room for the KV cache. Exercises
+    # _moe_dispatch + the capacity-bounded path under REAL sampling.
+    "moe-1b-4e": ModelConfig(
+        name="moe-1b-4e",
+        vocab_size=32000,
+        d_model=1024,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=4096,
+        rope_theta=10000.0,
+        n_experts=4,
+        n_experts_per_token=2,
+        moe_capacity_factor=1.25,
+        max_seq_len=4096,
+    ),
     # ~2.5M draft for arith-14m: trained on the same corpus it gives a
     # REAL speculative-decoding acceptance rate (examples/
     # spec_arith_demo.py) — between bench.py's --draft self ceiling and

@@ -407,31 +407,18 @@ class ContinuousBatcher:
         terminate immediately, with no overshoot to EOS/length (the
         engine's batch path can only device-stop single-token stops).
 
-        Only a TAIL WINDOW of tokens is decoded per check — the longest
-        stop's token length plus slack for a stop/multibyte sequence
-        straddling the window head — so per-request stop checking stays
-        O(T·window), not O(T²), on the thread that paces device steps.
-        Empty-decoding ids are filtered out of the window slice so the
-        window counts visible tokens, and a window hit is CONFIRMED
-        against the full decoded text before retiring the row: a
-        merge-based tokenizer can decode a tail window differently from
-        the full text at the window head, and retiring on such a false
-        positive would truncate output that the final
-        ``earliest_stop_cut`` pass then finds no stop in. The full
-        decode runs only on candidate hits, so the cost stays
-        amortized.
+        Window sizing, visible-token filtering, and the full-decode
+        confirm on candidate hits all live in
+        :meth:`utils.stops.VisibleIdFilter.confirmed_stop_hit` — the
+        one copy the engine's ``_chunked_stop_decode`` shares, so the
+        two retiring surfaces cannot drift.
         """
-        stops = slot.request.stop
-        if not stops:
-            return False
-        ids = self._vis_filter.visible_tail(
-            slot.generated, slot.request.stop_window
+        return self._vis_filter.confirmed_stop_hit(
+            slot.generated,
+            slot.request.stop,
+            slot.request.stop_window,
+            lambda: self._decoded_text(slot),
         )
-        text = self.tokenizer.decode(ids)
-        if not any(s in text for s in stops):
-            return False
-        full = self._decoded_text(slot)
-        return any(s in full for s in stops)
 
     def _retire(self, idx: int) -> None:
         slot = self._slots[idx]

@@ -84,6 +84,35 @@ class VisibleIdFilter:
                 break
         return [int(t) for t in ids[-span:] if int(t) not in self._skip]
 
+    def confirmed_stop_hit(
+        self,
+        ids: Sequence[int],
+        stops: Sequence[str],
+        window: int,
+        full_text,
+    ) -> bool:
+        """Incremental stop check: tail-window scan, then full-decode
+        confirm.
+
+        The shape both retiring surfaces (engine ``_chunked_stop_decode``
+        and the continuous batcher) must agree on: decode only a
+        :meth:`visible_tail` window per check (O(T·window) host work,
+        not O(T²)); on a window hit, CONFIRM against the full decoded
+        text before reporting a stop — a merge-based tokenizer can
+        decode a tail window differently from the full text at the
+        window head, and retiring on such a false positive silently
+        truncates a row the final ``earliest_stop_cut`` pass then finds
+        no stop in. ``full_text`` is a zero-arg callable (full decode
+        runs only on candidate hits, so the cost stays amortized).
+        """
+        if not stops:
+            return False
+        text = self._tok.decode(self.visible_tail(ids, window))
+        if not any(s in text for s in stops):
+            return False
+        full = full_text()
+        return any(s in full for s in stops)
+
 
 def stop_tail_window(tokenizer, stops: Iterable[str], slack: int = 8) -> int:
     """Tail-token window width for incremental stop checks.

@@ -1,0 +1,126 @@
+"""Tests for the multi-step multi-template arithmetic corpus
+(eval/arith2.py) — the round-5 hard accuracy task.
+
+Checks the properties the EM evidence depends on: exact arithmetic in
+every rendered CoT, chain-level holdout really excluding eval
+computations from training, deterministic splits, bounded values, and
+surface diversity (all frames exercised, distractors present).
+"""
+
+import random
+
+import pytest
+
+from llm_consensus_tpu.consensus.voting import extract_final_number
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+from llm_consensus_tpu.eval.arith2 import (
+    N_FRAMES,
+    Chain,
+    build_sft_examples,
+    eval_problems,
+    render_completion,
+    render_question,
+    sample_chain,
+)
+
+
+def test_chain_values_exact():
+    c = Chain(15, ("*", "/", "-"), (8, 3, 7))
+    assert c.values == [15, 120, 40, 33]
+    assert c.answer == 33
+
+
+def test_chain_inexact_division_raises():
+    with pytest.raises(ValueError):
+        Chain(10, ("/",), (3,)).values
+
+
+def test_sample_chain_bounds():
+    rng = random.Random(7)
+    for _ in range(500):
+        c = sample_chain(rng)
+        assert 2 <= len(c.ops) <= 4
+        assert len(c.ops) == len(c.operands)
+        for v in c.values:
+            assert 2 <= v <= 999, c
+
+
+def test_completion_parses_to_answer():
+    rng = random.Random(3)
+    for _ in range(100):
+        c = sample_chain(rng)
+        comp = render_completion(c)
+        assert extract_final_number(comp) == str(c.answer)
+        # Every intermediate step is stated and arithmetically true.
+        for step in comp.split("####")[0].strip().split(". "):
+            step = step.rstrip(".")
+            lhs, rhs = step.split(" = ")
+            a, op, b = lhs.split(" ")
+            got = {
+                "+": int(a) + int(b),
+                "-": int(a) - int(b),
+                "*": int(a) * int(b),
+                "/": int(a) // int(b),
+            }[op]
+            assert got == int(rhs), step
+
+
+def test_question_contains_operands_and_distractors():
+    rng = random.Random(11)
+    c = sample_chain(rng)
+    q = render_question(c, 0, rng, n_distractors=2)
+    assert str(c.v0) in q
+    for b in c.operands:
+        assert str(b) in q
+    # Two distractor sentences -> more sentences than start+steps+question.
+    assert q.count(".") + q.count("?") >= len(c.ops) + 2 + 2
+
+
+def test_eval_problems_deterministic_and_unique():
+    a, sa = eval_problems(40, seed=5)
+    b, sb = eval_problems(40, seed=5)
+    assert [p.question for p in a] == [p.question for p in b]
+    assert sa == sb
+    assert len(sa) == 40  # signatures unique
+    c, _ = eval_problems(40, seed=6)
+    assert [p.question for p in c] != [p.question for p in a]
+
+
+def test_all_frames_rotate_in_eval():
+    probs, _ = eval_problems(N_FRAMES * 2, seed=0)
+    # Round-robin frames: every frame's protagonist appears.
+    text = " ".join(p.question for p in probs)
+    for word in ("Maya", "Liam", "library", "Priya", "farmer", "Noah"):
+        assert word in text
+
+
+def test_sft_holdout_excluded():
+    _, sigs = eval_problems(30, seed=0)
+    tok = ByteTokenizer()
+    # Rebuild with the same sampling seed as build_sft_examples and
+    # verify none of the held-out chains were emitted by re-deriving
+    # the kept chains from a parallel walk of the rng.
+    examples = build_sft_examples(tok, 300, exclude=sigs, seed=1)
+    assert len(examples) == 300
+    rng = random.Random(1)
+    kept = 0
+    while kept < 300:
+        chain = sample_chain(rng)
+        if chain.signature in sigs:
+            continue
+        render_question(chain, rng.randrange(N_FRAMES), rng)
+        assert chain.signature not in sigs
+        kept += 1
+
+
+def test_sft_examples_trainable_shapes():
+    _, sigs = eval_problems(10, seed=0)
+    tok = ByteTokenizer()
+    examples = build_sft_examples(tok, 50, exclude=sigs, seed=2)
+    for p_ids, c_ids in examples:
+        assert p_ids[0] == tok.bos_id
+        assert c_ids[-1] == tok.eos_id
+        text = tok.decode(c_ids)
+        assert "####" in text
+        # Fits the arith-25m context (the preset built for this task).
+        assert len(p_ids) + len(c_ids) <= 768
