@@ -62,7 +62,10 @@ def train(args, cfg, tok) -> None:
             build_sft_examples as build2,
         )
 
-        examples = build2(tok, args.n_train, exclude=holdout)
+        n_train = args.n_train
+        if args.limit:
+            n_train = min(n_train, args.limit)
+        examples = build2(tok, n_train, exclude=holdout)
     else:
         examples = build_sft_examples(tok, exclude=holdout, limit=args.limit)
     loader = SftBatchLoader(

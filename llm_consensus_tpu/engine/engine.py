@@ -195,6 +195,12 @@ class InferenceEngine:
             from llm_consensus_tpu.parallel.partitioning import shard_params
 
             self.params = shard_params(self.params, mesh)
+            if self.draft is not None:
+                # The draft rides the same mesh as the target (its own
+                # tp sharding over `model`; batch over `data` inside
+                # speculative_generate).
+                d_cfg, d_params = self.draft
+                self.draft = (d_cfg, shard_params(d_params, mesh))
             self._data_sharding = NamedSharding(mesh, P("data"))
             # Batch buckets must tile the data axis evenly.
             dp = int(mesh.shape.get("data", 1))
@@ -1233,9 +1239,12 @@ class InferenceEngine:
 
         Requires ``draft=(cfg, params)`` at engine construction. Output
         text is IDENTICAL to greedy ``generate_texts`` (speculation only
-        changes speed — tested); greedy-only, single-device, bf16 KV,
-        one-shot prefill. Logprobs follow the same convention as the
-        plain path (target log_softmax of emitted tokens).
+        changes speed — tested); greedy-only, bf16 KV, one-shot
+        prefill. On a mesh engine the whole speculative program runs
+        sharded (batch over ``data``, target+draft params over
+        ``model`` — dp-mesh exactness tested). Logprobs follow the same
+        convention as the plain path (target log_softmax of emitted
+        tokens).
         """
         if self.draft is None:
             raise ValueError("engine was built without a draft model")
@@ -1260,6 +1269,10 @@ class InferenceEngine:
 
         draft_cfg, draft_params = self.draft
         tokens, lengths, n_real = self._prepare(prompts)
+        tokens_j, lengths_j = jnp.asarray(tokens), jnp.asarray(lengths)
+        if self._data_sharding is not None:
+            tokens_j = jax.device_put(tokens_j, self._data_sharding)
+            lengths_j = jax.device_put(lengths_j, self._data_sharding)
         # Same clamp as generate_texts — the k_spec+1 chunk slack lives
         # in speculative_generate's cache_len, NOT in the token budget,
         # so outputs stay identical to the greedy path.
@@ -1277,12 +1290,13 @@ class InferenceEngine:
                 self.params,
                 draft_cfg,
                 draft_params,
-                jnp.asarray(tokens),
-                jnp.asarray(lengths),
+                tokens_j,
+                lengths_j,
                 max_new_tokens=mnt,
                 k_spec=k_spec,
                 eos_id=self.tokenizer.eos_id,
                 pad_id=self.tokenizer.pad_id,
+                mesh=self.mesh,
             )
         return self._collect(out, n_real)
 

@@ -106,6 +106,7 @@ class SpecOutput:
         "eos_id",
         "pad_id",
         "cache_len",
+        "mesh",
     ),
 )
 def speculative_generate(
@@ -123,6 +124,7 @@ def speculative_generate(
     cache_len: int | None = None,
     temperature: jnp.ndarray | None = None,
     key: jax.Array | None = None,
+    mesh=None,
 ) -> SpecOutput:
     """Greedy speculative decode of right-padded prompts.
 
@@ -143,6 +145,15 @@ def speculative_generate(
     marginal equals direct target sampling exactly. Rows with
     temperature 0 take the greedy accept rule. Plain temperature
     sampling only (no top-k/top-p composition).
+
+    ``mesh`` (static) runs the whole program sharded: the batch axis —
+    prompts, both KV caches, and every per-row carry — shards over the
+    mesh's ``data`` axis (``partitioning.cache_pspecs`` layout, kv
+    heads over ``model``); the caller shards params (target AND draft)
+    with ``shard_params``. Draft proposal, chunk verification, and
+    acceptance are all per-row ops, so dp adds no collectives beyond
+    what the models' own tp shardings insert — output is bit-identical
+    to the single-device path (tested).
     """
     b, s = tokens.shape
     if cache_len is None:
@@ -159,9 +170,32 @@ def speculative_generate(
         t_eff = jnp.maximum(temperature, 1e-6)[:, None]  # [B, 1]
         greedy_row = (temperature <= 0.0)[:, None]  # [B, 1]
 
-    cache_t = KVCache.create(cfg_t, b, cache_len)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from llm_consensus_tpu.parallel.partitioning import cache_pspecs
+
+        _row = NamedSharding(mesh, P("data"))
+        _cache_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), cache_pspecs()
+        )
+
+        def _shard_cache(c):
+            return jax.lax.with_sharding_constraint(c, _cache_sh)
+
+        tokens = jax.lax.with_sharding_constraint(
+            tokens, NamedSharding(mesh, P("data", None))
+        )
+        lengths = jax.lax.with_sharding_constraint(lengths, _row)
+    else:
+
+        def _shard_cache(c):
+            return c
+
+    cache_t = _shard_cache(KVCache.create(cfg_t, b, cache_len))
     logits_t, cache_t = prefill(cfg_t, params_t, tokens, lengths, cache_t)
-    cache_d = KVCache.create(cfg_d, b, cache_len)
+    cache_d = _shard_cache(KVCache.create(cfg_d, b, cache_len))
     _, cache_d = prefill(cfg_d, params_d, tokens, lengths, cache_d)
 
     def _pick(logits2d, k):

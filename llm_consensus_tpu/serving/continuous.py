@@ -127,17 +127,64 @@ class ContinuousBatcher:
         params: dict,
         tokenizer: Tokenizer | None = None,
         config: ContinuousConfig | None = None,
+        mesh=None,
     ):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
         self.config = config or ContinuousConfig()
         c = self.config
+        # ``mesh``: run the serving hot loop sharded — slots (the decode
+        # batch axis) and the page pool's page axis over ``data``, kv
+        # heads over ``model``, params via ``shard_params`` (tp over
+        # ``model``, replicated over ``data``). Slot->page affinity
+        # below keeps each slot's pages on its own data shard so page
+        # reads/writes stay shard-local on real hardware.
+        self.mesh = mesh
+        self._dp = 1
+        self._row_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from llm_consensus_tpu.parallel.partitioning import shard_params
+
+            dp = int(mesh.shape.get("data", 1))
+            if c.max_slots % dp or c.n_pages % dp:
+                raise ValueError(
+                    f"max_slots ({c.max_slots}) and n_pages ({c.n_pages}) "
+                    f"must be multiples of the mesh data axis ({dp})"
+                )
+            self._dp = dp
+            self.params = shard_params(self.params, mesh)
+            self._row_sharding = NamedSharding(mesh, P("data"))
+            self._pool_sharding = PagedKVCache(
+                k=NamedSharding(mesh, P(None, "data", None, "model", None)),
+                v=NamedSharding(mesh, P(None, "data", None, "model", None)),
+                page_table=NamedSharding(mesh, P("data", None)),
+                length=NamedSharding(mesh, P("data")),
+            )
         self.cache = PagedKVCache.create(
             cfg, c.n_pages, c.page_size, c.max_slots, c.pages_per_seq
         )
-        # Host-side page allocator; page 0 is the NULL page.
-        self._free_pages = deque(range(1, c.n_pages))
+        if mesh is not None:
+            self.cache = jax.device_put(self.cache, self._pool_sharding)
+        # Host-side page allocator; page 0 is the NULL page. On a mesh,
+        # one free list per data shard: slot s (slots shard in
+        # contiguous blocks) draws only from its own shard's page range,
+        # so a sequence's table always points at shard-local pages.
+        pages_per_shard = c.n_pages // self._dp
+        self._shard_of_slot = [
+            s * self._dp // c.max_slots for s in range(c.max_slots)
+        ]
+        self._free_pages_by_shard = [
+            deque(
+                p
+                for p in range(j * pages_per_shard, (j + 1) * pages_per_shard)
+                if p != NULL_PAGE
+            )
+            for j in range(self._dp)
+        ]
         self._slots: list[_Slot | None] = [None] * c.max_slots
         self._waiting: deque[_Request] = deque()
         self._last_tokens = np.zeros((c.max_slots,), np.int32)
@@ -289,7 +336,9 @@ class ContinuousBatcher:
                 "active_slots": sum(s is not None for s in self._slots),
                 "max_slots": self.config.max_slots,
                 "waiting": len(self._waiting),
-                "free_pages": len(self._free_pages),
+                "free_pages": sum(
+                    len(d) for d in self._free_pages_by_shard
+                ),
                 "total_pages": self.config.n_pages - 1,
                 "completed_requests": self._completed,
                 "generated_tokens": self._generated_tokens,
@@ -323,18 +372,20 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         c = self.config
         while self._waiting:
-            free_slot = next(
-                (i for i, s in enumerate(self._slots) if s is None), None
-            )
-            if free_slot is None:
-                return
             with self._lock:
                 if not self._waiting:
                     return
                 req = self._waiting[0]
                 n_pages = self._pages_needed(req)
-                # n_pages - 1: page 0 is the reserved NULL page.
-                fits_ever = min(c.pages_per_seq, c.n_pages - 1)
+                # Largest shard-local pool that can EVER hold the
+                # request: page 0 (the reserved NULL page) lives in
+                # shard 0's range, so only the dp=1 pool loses it from
+                # the max.
+                per_shard = c.n_pages // self._dp
+                fits_ever = min(
+                    c.pages_per_seq,
+                    per_shard - (1 if self._dp == 1 else 0),
+                )
                 if n_pages > fits_ever:
                     self._waiting.popleft()
                     req.future.set_exception(
@@ -342,14 +393,30 @@ class ContinuousBatcher:
                             f"request needs {n_pages} pages but the "
                             f"configuration caps a sequence at {fits_ever} "
                             f"(pages_per_seq={c.pages_per_seq}, usable "
-                            f"pool={c.n_pages - 1})"
+                            f"per-shard pool="
+                            f"{per_shard - (1 if self._dp == 1 else 0)})"
                         )
                     )
                     continue
-                if len(self._free_pages) < n_pages:
-                    return  # pool exhausted; retry after retirements
+                # A free slot whose data shard still has enough pages
+                # (slot->page affinity keeps sequences shard-local).
+                free_slot = next(
+                    (
+                        i
+                        for i, s in enumerate(self._slots)
+                        if s is None
+                        and len(
+                            self._free_pages_by_shard[self._shard_of_slot[i]]
+                        )
+                        >= n_pages
+                    ),
+                    None,
+                )
+                if free_slot is None:
+                    return  # no slot/pages; retry after retirements
                 self._waiting.popleft()
-                pages = [self._free_pages.popleft() for _ in range(n_pages)]
+                pool = self._free_pages_by_shard[self._shard_of_slot[free_slot]]
+                pages = [pool.popleft() for _ in range(n_pages)]
 
             s_bucket = self._bucket(len(req.prompt_ids))
             padded = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
@@ -425,7 +492,9 @@ class ContinuousBatcher:
         assert slot is not None
         self.cache = release_seq(self.cache, jnp.int32(idx))
         with self._lock:
-            self._free_pages.extend(slot.pages)
+            self._free_pages_by_shard[self._shard_of_slot[idx]].extend(
+                slot.pages
+            )
             self._slots[idx] = None
             self._completed += 1
             self._generated_tokens += len(slot.generated)
@@ -455,15 +524,21 @@ class ContinuousBatcher:
             and (s.request.top_k != 0 or s.request.top_p != 1.0)
             for s in self._slots
         )
+        def rows(x):
+            arr = jnp.asarray(x)
+            if self._row_sharding is not None:
+                arr = jax.device_put(arr, self._row_sharding)
+            return arr
+
         next_tok, _, self.cache = self._jit_decode(
             self.params,
             self.cache,
-            jnp.asarray(self._last_tokens),
-            jnp.asarray(self._seeds),
-            jnp.asarray(self._counts),
-            jnp.asarray(temps),
-            jnp.asarray(self._topks),
-            jnp.asarray(self._topps),
+            rows(self._last_tokens),
+            rows(self._seeds),
+            rows(self._counts),
+            rows(temps),
+            rows(self._topks),
+            rows(self._topps),
             filters_active,
         )
         with self._lock:

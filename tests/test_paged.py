@@ -613,3 +613,60 @@ def test_hit_stop_confirms_window_hit_against_full_text():
         request=SimpleNamespace(stop=("b",), stop_window=1),
     )
     assert ContinuousBatcher._hit_stop(host, slot2)
+
+
+def test_continuous_batcher_on_mesh_matches_single_device():
+    """Mesh batcher (slots + page pool over `data`, kv heads over
+    `model`, slot-affinity page allocation) serves byte-identical text
+    to the single-device batcher for the same greedy burst (round-4
+    verdict item 4)."""
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    params = _params()
+    ccfg = ContinuousConfig(
+        max_slots=4,
+        page_size=16,
+        n_pages=64,
+        pages_per_seq=8,
+        max_new_tokens=8,
+        seq_buckets=(16, 32, 64),
+    )
+    prompts = ["hello world", "the quick brown fox", "abc", "mesh", "zed"]
+
+    plain = ContinuousBatcher(CFG, params, config=ccfg)
+    try:
+        want = [
+            f.result(timeout=120).text
+            for f in [plain.submit(p) for p in prompts]
+        ]
+    finally:
+        plain.close()
+
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    sharded = ContinuousBatcher(CFG, params, config=ccfg, mesh=mesh)
+    try:
+        got = [
+            f.result(timeout=120).text
+            for f in [sharded.submit(p) for p in prompts]
+        ]
+        stats = sharded.stats()
+    finally:
+        sharded.close()
+    assert got == want
+    assert stats["completed_requests"] == len(prompts)
+    assert stats["free_pages"] == 63  # all pages returned (page 0 reserved)
+
+
+def test_mesh_batcher_rejects_indivisible_shapes():
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    with pytest.raises(ValueError, match="multiples of the mesh"):
+        ContinuousBatcher(
+            CFG,
+            _params(),
+            config=ContinuousConfig(
+                max_slots=3, page_size=16, n_pages=64, pages_per_seq=8
+            ),
+            mesh=mesh,
+        )
