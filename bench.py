@@ -111,6 +111,15 @@ def main() -> int:
     )
     p.add_argument("--serve-requests", type=int, default=64)
     p.add_argument("--serve-slots", type=int, default=16)
+    p.add_argument(
+        "--serve-chunk",
+        type=int,
+        default=16,
+        help="decode steps per device program in the serving bench "
+        "(ContinuousConfig.steps_per_sync): the host pays one "
+        "dispatch+fetch per chunk, and on a tunneled chip that RTT "
+        "dominates the decode step itself",
+    )
     args = p.parse_args()
 
     if args.cpu:
@@ -271,10 +280,17 @@ def main() -> int:
     compile_s = time.perf_counter() - t0
     print(f"[bench] compile+first run: {compile_s:.1f}s", file=sys.stderr)
 
-    # Timed steady-state (tree-level sync per iteration — see above).
+    # Timed steady-state. Host-fetch sync (np.asarray of the token
+    # buffer, 32 KB — negligible): tree-level block_until_ready was
+    # enough for THIS program in r4/r5 measurements (plausible step
+    # times), but r5 caught it not waiting on the speculative
+    # while_loop program, so every timed leg now uses the one sync the
+    # tunnel runtime cannot fake.
+    import numpy as _np
+
     t0 = time.perf_counter()
     for i in range(args.iters):
-        jax.block_until_ready(run(jax.random.fold_in(key, i + 1)))
+        _np.asarray(run(jax.random.fold_in(key, i + 1)).tokens)
     wall = (time.perf_counter() - t0) / args.iters
 
     candidate_tokens = b * args.new_tokens
@@ -355,23 +371,29 @@ def _bench_speculative(args, cfg, params, tokens, lengths) -> int:
             kv_quant=False,
         )
 
+    import numpy as np
+
     t0 = time.perf_counter()
-    jax.block_until_ready(run_spec(0))
-    jax.block_until_ready(run_plain(0))
+    np.asarray(run_spec(0).tokens)  # host fetch: see timed-loop note
+    np.asarray(run_plain(0).tokens)
     print(
         f"[bench] compile+first run: {time.perf_counter() - t0:.1f}s",
         file=sys.stderr,
     )
-    # Tree-level sync (see main()): single-array block does not wait on
-    # this tunnel runtime.
+    # HOST-FETCH sync, not block_until_ready: round 5 measured the
+    # spec while_loop program "completing" in 1-2 ms under tree-level
+    # block (515k tok/s plain at N=8 — physically impossible; ~170x
+    # the real rate), i.e. on this tunnel runtime tree-level block is
+    # not sufficient for every program shape. Fetching the token
+    # buffer to host (32 KB) is the sync the runtime cannot fake.
     t0 = time.perf_counter()
     for i in range(args.iters):
         out = run_spec(i + 1)
-        jax.block_until_ready(out)
+        np.asarray(out.tokens)
     spec_wall = (time.perf_counter() - t0) / args.iters
     t0 = time.perf_counter()
     for i in range(args.iters):
-        jax.block_until_ready(run_plain(i + 1))
+        np.asarray(run_plain(i + 1).tokens)
     plain_wall = (time.perf_counter() - t0) / args.iters
 
     produced = float(jnp.sum(out.num_tokens))
@@ -413,7 +435,10 @@ def _bench_serving(args, cfg, params) -> int:
     buckets = [64]
     while buckets[-1] < args.prompt_len:
         buckets.append(buckets[-1] * 2)
-    pages_per_seq = -(-(buckets[-1] + args.new_tokens) // pg)
+    # + chunk - 1: rows finishing mid-chunk overshoot into their pages.
+    pages_per_seq = -(
+        -(buckets[-1] + args.new_tokens + args.serve_chunk - 1) // pg
+    )
     n_pages = 1 + args.serve_slots * pages_per_seq * 2  # 2x headroom
     batcher = ContinuousBatcher(
         cfg,
@@ -425,6 +450,7 @@ def _bench_serving(args, cfg, params) -> int:
             pages_per_seq=pages_per_seq,
             max_new_tokens=args.new_tokens,
             seq_buckets=tuple(buckets),
+            steps_per_sync=args.serve_chunk,
         ),
     )
     # Salted prompts (the tunnel runtime replays previously-seen
@@ -467,6 +493,7 @@ def _bench_serving(args, cfg, params) -> int:
                 "metric": f"serving requests/sec ({cfg.name}, "
                 f"{args.serve_requests} reqs, slots={args.serve_slots}, "
                 f"decode {args.new_tokens} @ ~{args.prompt_len} prompt, "
+                f"chunk={args.serve_chunk}, "
                 f"paged pallas={cfg.use_pallas}, "
                 f"{n_tokens / wall:.0f} generated tok/s, "
                 f"{steps} decode steps)",

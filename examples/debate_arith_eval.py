@@ -1,0 +1,159 @@
+"""Debate EM + per-question latency with a REAL trained engine.
+
+BASELINE.md config[4] (multi-round debate with iterative re-vote),
+measured the way the reference's own UX is experienced — one question
+at a time (``src/main.rs:430-464``) — so the report carries per-question
+wall clock alongside EM, on whatever device runs it (the recorded runs
+use the driver's TPU chip).
+
+Narrow SFT models answer reliably only in their trained format, so the
+debate uses the training prompt as ``initial_template`` and a revise
+template that embeds peers' answers ahead of the known format
+(``DebateConfig.initial_template/revise_template``, the round-4
+configurable-template work).
+
+Usage:
+    python examples/debate_arith_eval.py --ckpt runs/arith14m \
+        [--task arith|arith2] [--model <preset>] --report out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from llm_consensus_tpu.checkpoint.io import restore_params_for_inference
+from llm_consensus_tpu.consensus.debate import DebateConfig, run_debate
+from llm_consensus_tpu.consensus.voting import extract_final_number
+from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+from llm_consensus_tpu.eval.gsm8k import _PROMPT, exact_match
+from llm_consensus_tpu.models.configs import get_config
+
+# Revise template in the trained format: peers' answers arrive as
+# leading context, then the EXACT prompt shape the model was trained on
+# (everything after the peers block is byte-identical to _PROMPT).
+_REVISE_TRAINED = (
+    "Other attempts at this problem answered: {peers}\n\n" + _PROMPT
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt", default="runs/arith14m")
+    p.add_argument("--model", default="")
+    p.add_argument("--task", default="arith", choices=("arith", "arith2"))
+    p.add_argument("--n-problems", type=int, default=20)
+    p.add_argument("--n-candidates", type=int, default=8)
+    p.add_argument("--max-rounds", type=int, default=2)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--quorum", type=float, default=0.9)
+    p.add_argument("--max-new-tokens", type=int, default=0)
+    p.add_argument("--method", default="majority")
+    p.add_argument("--eval-seed", type=int, default=0)
+    p.add_argument("--report", default="")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    if not args.model:
+        args.model = "arith-25m" if args.task == "arith2" else "arith-14m"
+    if not args.max_new_tokens:
+        args.max_new_tokens = 112 if args.task == "arith2" else 64
+
+    if args.task == "arith2":
+        from llm_consensus_tpu.eval.arith2 import eval_problems
+
+        problems, _ = eval_problems(args.n_problems, seed=args.eval_seed)
+    else:
+        from llm_consensus_tpu.eval.arith import eval_split
+
+        problems, _ = eval_split(args.n_problems, seed=args.eval_seed)
+
+    cfg = get_config(args.model)
+    params, step = restore_params_for_inference(cfg, args.ckpt, jnp.bfloat16)
+    print(f"[debate] {cfg.name} @ step {step}", file=sys.stderr)
+    engine = InferenceEngine(
+        cfg,
+        params,
+        tokenizer=ByteTokenizer(),
+        engine_config=EngineConfig(max_new_tokens=args.max_new_tokens),
+    )
+    dcfg = DebateConfig(
+        n_candidates=args.n_candidates,
+        max_rounds=args.max_rounds,
+        temperature=args.temperature,
+        quorum=args.quorum,
+        max_new_tokens=args.max_new_tokens,
+        method=args.method,
+        initial_template=_PROMPT,
+        revise_template=_REVISE_TRAINED,
+        # Vote on the extracted final number (the EM key), not on whole
+        # canonicalized texts — CoT wording varies per candidate.
+    )
+
+    correct = 0
+    latencies, rounds_taken = [], []
+    total_tokens = 0
+    for i, prob in enumerate(problems):
+        t0 = time.perf_counter()
+        import dataclasses
+
+        res = run_debate(
+            engine,
+            prob.question,
+            dataclasses.replace(dcfg, seed=args.eval_seed * 1000 + i),
+            key_fn=lambda t: extract_final_number(t) or "<none>",
+        )
+        latencies.append(time.perf_counter() - t0)
+        rounds_taken.append(res.n_rounds)
+        total_tokens += res.total_tokens
+        pred = res.vote.winner if res.vote.winner != "<none>" else None
+        ok = exact_match(pred, prob.answer)
+        correct += ok
+        print(
+            f"[debate] q{i}: rounds={res.n_rounds} "
+            f"t={latencies[-1]:.2f}s em={ok}",
+            file=sys.stderr,
+        )
+    steady = sorted(latencies[1:]) or latencies
+    out = {
+        "model": cfg.name,
+        "task": args.task,
+        "n_problems": args.n_problems,
+        "n_candidates": args.n_candidates,
+        "max_rounds": args.max_rounds,
+        "temperature": args.temperature,
+        "quorum": args.quorum,
+        "method": args.method,
+        "em": round(correct / max(1, len(problems)), 4),
+        "mean_rounds": (
+            round(sum(rounds_taken) / len(rounds_taken), 2)
+            if rounds_taken
+            else None
+        ),
+        "total_candidate_tokens": total_tokens,
+        "first_question_s": round(latencies[0], 3) if latencies else None,
+        "latency_median_s": (
+            round(steady[len(steady) // 2], 3) if steady else None
+        ),
+        "latency_max_s": round(max(steady), 3) if steady else None,
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+    if args.report:
+        Path(args.report).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.report).write_text(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -95,7 +95,15 @@ def main() -> int:
     problems, _ = eval_split(args.n_problems, seed=0)
     correct = 0
     total_tokens = 0
+    # Per-question wall clock: the reference's UX is interactive (one
+    # question at a time at the REPL, src/main.rs:430-464), so what a
+    # question COSTS end-to-end matters alongside EM. First question
+    # carries compile time; report it separately from steady state.
+    import time
+
+    latencies = []
     for i, prob in enumerate(problems):
+        t0 = time.perf_counter()
         res = heterogeneous_panel_vote(
             engines,
             _PROMPT.format(q=prob.question),
@@ -104,9 +112,11 @@ def main() -> int:
             seed=100 + i,
             max_new_tokens=args.max_new_tokens,
         )
+        latencies.append(time.perf_counter() - t0)
         total_tokens += res.total_tokens
         ok = exact_match(res.vote.winner, prob.answer)
         correct += ok
+    steady = sorted(latencies[1:]) or latencies
     out = {
         "panel": list(engines),
         "weights": weights,
@@ -114,6 +124,11 @@ def main() -> int:
         "n_per_model": args.n_per_model,
         "em": round(correct / max(1, args.n_problems), 4),
         "total_candidate_tokens": total_tokens,
+        "first_question_s": round(latencies[0], 3) if latencies else None,
+        "latency_median_s": (
+            round(steady[len(steady) // 2], 3) if steady else None
+        ),
+        "latency_max_s": round(max(steady), 3) if steady else None,
         "device": jax.devices()[0].platform,
     }
     print(json.dumps(out))

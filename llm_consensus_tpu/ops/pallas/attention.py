@@ -556,36 +556,39 @@ def _decode_q8_stacked_kernel(
 def _paged_decode_kernel(
     tbl_ref,  # [B*P] int32 scalar-prefetch: flattened page table
     len_ref,  # [B] int32 scalar-prefetch: valid lengths
-    q_ref,  # [1, 1, G, D]
-    k_ref,  # [1, pg, 1, D] — ONE page of the pool for this kv head
+    q_ref,  # [1, Hkv, G, D]
+    k_ref,  # [1, pg, Hkv, D] — ONE page of the pool, all kv heads
     v_ref,
-    o_ref,  # [1, 1, G, D]
-    m_ref,  # [G, 1] f32 scratch: running max
-    l_ref,  # [G, 1] f32 scratch: running denominator
-    acc_ref,  # [G, D] f32 scratch: running numerator
+    o_ref,  # [1, Hkv, G, D]
+    m_ref,  # [Hkv*G, 1] f32 scratch: running max
+    l_ref,  # [Hkv*G, 1] f32 scratch: running denominator
+    acc_ref,  # [Hkv*G, D] f32 scratch: running numerator
     *,
     scale: float,
     window: int,
 ):
-    """One (row, kv-head, page) program — online softmax across pages.
+    """One (row, page) program — online softmax across pages, all kv
+    heads per program (static unroll; Mosaic requires the pool block's
+    trailing dims to cover the [Hkv, D] axes whole, so a per-head grid
+    axis cannot legally block the native pool layout).
 
     The page grid dimension is innermost, so TPU's sequential grid
     execution makes the VMEM scratch a legal accumulator: page j=0
-    initializes, every page folds its [G, pg] score tile in, the last
-    page writes ``acc / l``. Pages beyond the row's valid length
-    contribute exp(-inf)=0 — the NULL page's garbage never reaches the
-    output, mirroring the gather path's masking."""
+    initializes, every page folds its per-head [G, pg] score tile in,
+    the last page writes ``acc / l``. Pages beyond the row's valid
+    length contribute exp(-inf)=0 — the NULL page's garbage never
+    reaches the output, mirroring the gather path's masking."""
     b = pl.program_id(0)
-    j = pl.program_id(2)
-    n_pages = pl.num_programs(2)
-    _, pg, _, d = k_ref.shape
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    _, pg, hkv, d = k_ref.shape
     g = q_ref.shape[2]
 
     @pl.when(j == 0)
     def _init():
-        m_ref[...] = jnp.full((g, 1), _NEG_INF, jnp.float32)
-        l_ref[...] = jnp.zeros((g, 1), jnp.float32)
-        acc_ref[...] = jnp.zeros((g, d), jnp.float32)
+        m_ref[...] = jnp.full((hkv * g, 1), _NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros((hkv * g, 1), jnp.float32)
+        acc_ref[...] = jnp.zeros((hkv * g, d), jnp.float32)
 
     valid = len_ref[b]
     # Pages wholly BEFORE the sliding window contribute exactly nothing
@@ -596,45 +599,53 @@ def _paged_decode_kernel(
 
     @pl.when(live)
     def _fold_page():
-        q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
-        k = k_ref[0, :, 0, :]  # [pg, D]
-        scores = jax.lax.dot_general(
-            q,
-            k.astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [G, pg]
         slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
         in_range = slot < valid
         if window > 0:
             # Sliding window (Mistral): only the last `window` slots
             # attend — same rule as ops.attention.decode_attention.
             in_range &= slot >= valid - window
-        scores = jnp.where(in_range, scores, _NEG_INF)
+        for head in range(hkv):  # static unroll over kv heads
+            hs = slice(head * g, (head + 1) * g)
+            q = q_ref[0, head].astype(jnp.float32)  # [G, D]
+            k = k_ref[0, :, head, :]  # [pg, D]
+            scores = jax.lax.dot_general(
+                q,
+                k.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, pg]
+            scores = jnp.where(in_range, scores, _NEG_INF)
 
-        m_prev = m_ref[...]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-        # A fully-masked page (or row) keeps m at -inf; exp(-inf - -inf)
-        # would be NaN — substitute 0 so p stays 0 for masked slots.
-        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(scores - m_safe)  # [G, pg]
-        alpha = jnp.where(
-            m_prev <= _NEG_INF / 2, 0.0, jnp.exp(m_prev - m_safe)
-        )
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p,
-            v_ref[0, :, 0, :].astype(jnp.float32),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [G, D]
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = m_new
+            m_prev = m_ref[hs]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=-1, keepdims=True)
+            )
+            # A fully-masked page (or row) keeps m at -inf;
+            # exp(-inf - -inf) would be NaN — substitute 0 so p stays 0
+            # for masked slots.
+            m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+            p = jnp.exp(scores - m_safe)  # [G, pg]
+            alpha = jnp.where(
+                m_prev <= _NEG_INF / 2, 0.0, jnp.exp(m_prev - m_safe)
+            )
+            l_ref[hs] = l_ref[hs] * alpha + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            pv = jax.lax.dot_general(
+                p,
+                v_ref[0, :, head, :].astype(jnp.float32),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G, D]
+            acc_ref[hs] = acc_ref[hs] * alpha + pv
+            m_ref[hs] = m_new
 
     @pl.when(j == n_pages - 1)
     def _write():
         denom = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        out = acc_ref[...] / denom  # [Hkv*G, D]
+        o_ref[0] = out.reshape(hkv, g, d).astype(o_ref.dtype)
 
 
 def paged_decode_attention(
@@ -677,7 +688,7 @@ def paged_decode_attention(
     tbl = page_table.reshape(-1).astype(jnp.int32)
     lens = valid_len.astype(jnp.int32)
 
-    def _page_map(bi, hi, ji, tbl, lens):
+    def _page_map(bi, ji, tbl, lens):
         page = tbl[bi * p_per + ji]
         if window > 0:
             # Pages wholly before the window remap to the sentinel page
@@ -685,25 +696,25 @@ def paged_decode_attention(
             # block, so their DMAs collapse instead of streaming K/V the
             # kernel would only mask away (the pl.when skip inside).
             page = jnp.where((ji + 1) * pg > lens[bi] - window, page, 0)
-        return (page, 0, hi, 0)
+        return (page, 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page table, valid lengths
-        grid=(b, hkv, p_per),
+        grid=(b, p_per),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, g, d), lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)
+                (1, hkv, g, d), lambda bi, ji, tbl, lens: (bi, 0, 0, 0)
             ),
-            pl.BlockSpec((1, pg, 1, d), _page_map),
-            pl.BlockSpec((1, pg, 1, d), _page_map),
+            pl.BlockSpec((1, pg, hkv, d), _page_map),
+            pl.BlockSpec((1, pg, hkv, d), _page_map),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, g, d), lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)
+            (1, hkv, g, d), lambda bi, ji, tbl, lens: (bi, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((hkv * g, 1), jnp.float32),
+            pltpu.VMEM((hkv * g, 1), jnp.float32),
+            pltpu.VMEM((hkv * g, d), jnp.float32),
         ],
     )
     out = pl.pallas_call(

@@ -77,6 +77,21 @@ class ContinuousConfig:
     # Over-long prompts: left-truncate to the largest bucket (keeping the
     # question tail) with a warning, or reject when False.
     truncate_prompts: bool = True
+    # Decode steps per device program (one host dispatch+fetch per
+    # chunk). The host-driven loop pays a host<->device round trip per
+    # sync — on a remote/tunneled chip that RTT dominates the ~ms decode
+    # step itself (round 5 measured ~113 ms/step at chunk 1 on the
+    # tunnel, i.e. >97% RTT; `bench.py --serve-chunk 16` opts in).
+    # Retirement/admission happen at chunk boundaries, so a finished
+    # row overshoots up to chunk-1 tokens (discarded on host; page
+    # reservations carry the slack — raising this on a config whose
+    # pages_per_seq was sized exactly may need one more page per
+    # sequence) and a waiting request can be admitted up to chunk-1
+    # steps late. Pure throughput/latency knob: outputs are
+    # chunk-size-invariant (per-token PRNG streams are (seed, index) —
+    # tested). Default 1 = per-token retirement/admission, the right
+    # latency behavior on a locally-attached chip.
+    steps_per_sync: int = 1
 
 
 @dataclass
@@ -230,20 +245,37 @@ class ContinuousBatcher:
         topps,
         filters_active,
     ):
-        logits, cache = decode_step_paged(
-            self.cfg, params, tokens[:, None], cache
+        """``steps_per_sync`` decode+sample steps as ONE device program.
+
+        Returns ``([slots, k] tokens, [slots, k] logprobs, cache)``.
+        Each step folds ``(seed, count+j)`` into the per-slot PRNG —
+        the same stream a chunk-of-1 loop would draw, so results are
+        chunk-size-invariant (tested).
+        """
+        k = max(1, self.config.steps_per_sync)
+
+        def body(carry, _):
+            cache, tok, cnt = carry
+            logits, cache = decode_step_paged(
+                self.cfg, params, tok[:, None], cache
+            )
+            keys = jax.vmap(
+                lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+            )(seeds, cnt)
+            # filters_active is STATIC (two cached programs): the
+            # all-defaults workload — every active request with
+            # top_k=0, top_p=1.0 — never pays the filters' full-vocab
+            # sort.
+            next_tok, logp = sample_token_per_request(
+                logits, keys, temps, topks, topps,
+                filters_active=filters_active,
+            )
+            return (cache, next_tok, cnt + 1), (next_tok, logp)
+
+        (cache, _, _), (toks, logps) = jax.lax.scan(
+            body, (cache, tokens, counts), None, length=k
         )
-        keys = jax.vmap(
-            lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
-        )(seeds, counts)
-        # filters_active is STATIC (two cached programs): the
-        # all-defaults workload — every active request with top_k=0,
-        # top_p=1.0 — never pays the filters' full-vocab sort.
-        next_tok, logp = sample_token_per_request(
-            logits, keys, temps, topks, topps,
-            filters_active=filters_active,
-        )
-        return next_tok, logp, cache
+        return toks.T, logps.T, cache
 
     def _prefill_fn(self, s_bucket: int):
         """Jitted per-bucket: prefill one prompt densely, scatter to pages."""
@@ -365,7 +397,15 @@ class ContinuousBatcher:
         return _next_bucket(n, self.config.seq_buckets)
 
     def _pages_needed(self, req: _Request) -> int:
-        total = self._bucket(len(req.prompt_ids)) + req.max_new_tokens
+        # + steps_per_sync - 1: a row finishing mid-chunk keeps writing
+        # K/V until the chunk boundary (those tokens are discarded on
+        # host); its pages must absorb the overshoot.
+        total = (
+            self._bucket(len(req.prompt_ids))
+            + req.max_new_tokens
+            + max(1, self.config.steps_per_sync)
+            - 1
+        )
         pg = self.config.page_size
         return -(-total // pg)
 
@@ -541,21 +581,31 @@ class ContinuousBatcher:
             rows(self._topps),
             filters_active,
         )
+        k = max(1, self.config.steps_per_sync)
         with self._lock:
-            self._decode_steps += 1
-        next_np = np.asarray(next_tok)
+            self._decode_steps += k
+        next_np = np.asarray(next_tok)  # [slots, k] — THE host sync
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
-            tok = int(next_np[i])
-            slot.generated.append(tok)
-            self._last_tokens[i] = tok
-            self._counts[i] += 1
-            done = (
-                tok == self.tokenizer.eos_id
-                or len(slot.generated) >= slot.request.max_new_tokens
-                or self._hit_stop(slot)
-            )
+            # Device streams advanced k for every row; host counters
+            # must track the DEVICE stream, not the kept tokens, so a
+            # surviving row's next chunk folds the right PRNG indices.
+            self._counts[i] += k
+            done = False
+            for j in range(k):
+                tok = int(next_np[i, j])
+                slot.generated.append(tok)
+                self._last_tokens[i] = tok
+                done = (
+                    tok == self.tokenizer.eos_id
+                    or len(slot.generated) >= slot.request.max_new_tokens
+                    or self._hit_stop(slot)
+                )
+                if done:
+                    # Tokens past this point in the chunk were decoded
+                    # on device but never belonged to the request.
+                    break
             if done:
                 self._retire(i)
 

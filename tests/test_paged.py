@@ -670,3 +670,36 @@ def test_mesh_batcher_rejects_indivisible_shapes():
             ),
             mesh=mesh,
         )
+
+
+def test_continuous_chunk_size_invariance():
+    """steps_per_sync is a pure throughput knob: chunk 1 and chunk 4
+    serve identical text for the same greedy AND sampled requests (the
+    per-token PRNG stream is (seed, index), independent of chunking)."""
+    params = _params()
+
+    def run(chunk):
+        b = ContinuousBatcher(
+            CFG,
+            params,
+            config=ContinuousConfig(
+                max_slots=4,
+                page_size=16,
+                n_pages=64,
+                pages_per_seq=8,
+                max_new_tokens=8,
+                seq_buckets=(16, 32, 64),
+                steps_per_sync=chunk,
+            ),
+        )
+        try:
+            futs = [
+                b.submit("hello world"),
+                b.submit("the quick", temperature=0.9, seed=7),
+                b.submit("abc", temperature=1.3, seed=11),
+            ]
+            return [f.result(timeout=120).text for f in futs]
+        finally:
+            b.close()
+
+    assert run(1) == run(4)
