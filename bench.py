@@ -112,6 +112,14 @@ def main() -> int:
     p.add_argument("--serve-requests", type=int, default=64)
     p.add_argument("--serve-slots", type=int, default=16)
     p.add_argument(
+        "--moe-dense",
+        action="store_true",
+        help="MoE presets: dense all-experts compute (capacity factor "
+        "0) instead of capacity-bounded dispatch — at decode batch "
+        "sizes the dispatch's sort/gather/scatter can cost more than "
+        "the E/k extra FLOPs it saves",
+    )
+    p.add_argument(
         "--serve-chunk",
         type=int,
         default=16,
@@ -132,6 +140,8 @@ def main() -> int:
     from llm_consensus_tpu.models.transformer import init_params
 
     cfg = get_config(args.model)
+    if args.moe_dense and cfg.is_moe:
+        cfg = cfg.with_(moe_capacity_factor=0.0)
     probe_timeout = 180.0
     if not args.cpu and not _chip_responsive(probe_timeout):
         # The tunneled chip can go unreachable for hours (observed
@@ -303,7 +313,13 @@ def main() -> int:
             {
                 "metric": f"candidate-tokens/sec/chip ({cfg.name}, N={b}, "
                 f"decode {args.new_tokens} @ prompt {s}, quant={args.quant}, "
-                f"kv={args.kv_quant}, pallas={cfg.use_pallas}{fallback})",
+                f"kv={args.kv_quant}, pallas={cfg.use_pallas}"
+                + (
+                    ", moe=dense"
+                    if cfg.is_moe and cfg.moe_capacity_factor == 0
+                    else ""
+                )
+                + f"{fallback})",
                 "value": round(tps_per_chip, 2),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tps_per_chip / 1000.0, 4),
