@@ -120,6 +120,13 @@ def main() -> int:
         "the E/k extra FLOPs it saves",
     )
     p.add_argument(
+        "--moe-capacity",
+        action="store_true",
+        help="MoE presets: pin the capacity-bounded dispatch at every "
+        "shape (moe_dense_decode_tokens=0), disabling the decode-shape "
+        "dense fallback — the A/B row against the default auto policy",
+    )
+    p.add_argument(
         "--serve-chunk",
         type=int,
         default=16,
@@ -140,8 +147,17 @@ def main() -> int:
     from llm_consensus_tpu.models.transformer import init_params
 
     cfg = get_config(args.model)
+    if args.moe_dense and args.moe_capacity:
+        print("--moe-dense and --moe-capacity are mutually exclusive",
+              file=sys.stderr)
+        return 2
     if args.moe_dense and cfg.is_moe:
         cfg = cfg.with_(moe_capacity_factor=0.0)
+    if args.moe_capacity and cfg.is_moe:
+        cfg = cfg.with_(
+            moe_dense_decode_tokens=0,
+            moe_capacity_factor=cfg.moe_capacity_factor or 1.25,
+        )
     probe_timeout = 180.0
     if not args.cpu and not _chip_responsive(probe_timeout):
         # The tunneled chip can go unreachable for hours (observed
@@ -315,8 +331,9 @@ def main() -> int:
                 f"decode {args.new_tokens} @ prompt {s}, quant={args.quant}, "
                 f"kv={args.kv_quant}, pallas={cfg.use_pallas}"
                 + (
-                    ", moe=dense"
-                    if cfg.is_moe and cfg.moe_capacity_factor == 0
+                    # Which MLP path the N-token DECODE program traced.
+                    (", moe=dense" if cfg.moe_dense_at(b) else ", moe=capacity")
+                    if cfg.is_moe
                     else ""
                 )
                 + f"{fallback})",

@@ -156,6 +156,25 @@ def speculative_generate(
     to the single-device path (tested).
     """
     b, s = tokens.shape
+    # Prefill keeps the CALLER's config: spec's prefill runs the same
+    # [B, S] one-shot program shape as the plain path's, so the same
+    # cfg yields the same trace-time MoE dispatch choice there. Only
+    # the decode-side programs need a pin (below).
+    cfg_t_prefill = cfg_t
+    if cfg_t.is_moe and cfg_t.moe_capacity_factor > 0:
+        # The verify chunk (b*(k_spec+1) tokens) and the plain decode
+        # step (b tokens) sit on opposite sides of the trace-time MoE
+        # dense-fallback threshold for mid-sized batches; the two
+        # dispatch paths differ numerically when capacity binds, which
+        # would break spec's greedy token-identity with the plain path.
+        # Pin the decode-side programs (draft steps + verify chunks) to
+        # the path the plain decode step would take: all-dense when b
+        # is at/below the threshold, all-capacity otherwise.
+        cfg_t = (
+            cfg_t.with_moe_dense_up_to(b * (k_spec + 1))
+            if cfg_t.moe_dense_at(b)
+            else cfg_t.with_moe_capacity_pinned()
+        )
     if cache_len is None:
         # +k_spec+1 slack: a chunk may write past the last emitted slot.
         cache_len = s + max_new_tokens + k_spec + 1
@@ -194,7 +213,7 @@ def speculative_generate(
             return c
 
     cache_t = _shard_cache(KVCache.create(cfg_t, b, cache_len))
-    logits_t, cache_t = prefill(cfg_t, params_t, tokens, lengths, cache_t)
+    logits_t, cache_t = prefill(cfg_t_prefill, params_t, tokens, lengths, cache_t)
     cache_d = _shard_cache(KVCache.create(cfg_d, b, cache_len))
     _, cache_d = prefill(cfg_d, params_d, tokens, lengths, cache_d)
 

@@ -49,6 +49,21 @@ class ModelConfig:
     # routed tokens, capacity = ceil(T*k/E * factor)); 0 = dense
     # all-experts compute (exact, E/k x the FLOPs).
     moe_capacity_factor: float = 0.0
+    # Token count at or below which an MoE layer takes the dense
+    # all-experts path even when moe_capacity_factor > 0. At decode
+    # shapes every expert's weights stream from HBM regardless of
+    # routing (any batch of >= E tokens touches all E experts), so the
+    # capacity dispatch saves no bandwidth there — it only adds the
+    # [T, E, C] mask-build chain (top_k/cumsum/one_hot/scatter) to a
+    # memory-bound step (measured 10x off the weight-read roofline on
+    # v5e, PERF.md r5). Shapes are static under jit, so the switch is
+    # trace-time Python with zero runtime cost; prefill/training token
+    # counts exceed the threshold and keep the capacity path. 0 pins
+    # the capacity path at every shape (tests / A-B benches). The two
+    # paths differ numerically when capacity binds, so call sites that
+    # promise cross-program identity pin one path for all their
+    # programs (speculative_generate, prefill_chunked).
+    moe_dense_decode_tokens: int = 256
     # Router auxiliary loss weights for MoE TRAINING (Switch-style
     # load-balance + router z-loss, models/transformer.moe_router_aux);
     # inference ignores them.
@@ -72,6 +87,33 @@ class ModelConfig:
 
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
+
+    # -- MoE dispatch-path selection (single source of truth) ----------
+    # _mlp picks its path with moe_dense_at; call sites that promise
+    # cross-program numeric identity pin one path for all their
+    # programs with the two helpers below.
+
+    def moe_dense_at(self, n_tokens: int) -> bool:
+        """True when an MoE layer at this per-program token count traces
+        the dense all-experts path (capacity factor 0, or at/below the
+        trace-time dense-fallback threshold)."""
+        return (
+            self.moe_capacity_factor == 0
+            or n_tokens <= self.moe_dense_decode_tokens
+        )
+
+    def with_moe_capacity_pinned(self) -> "ModelConfig":
+        """Capacity dispatch at EVERY program shape (threshold 0)."""
+        return self.with_(moe_dense_decode_tokens=0)
+
+    def with_moe_dense_up_to(self, n_tokens: int) -> "ModelConfig":
+        """Dense path for every program of <= n_tokens tokens (raises
+        the threshold; never lowers it)."""
+        return self.with_(
+            moe_dense_decode_tokens=max(
+                self.moe_dense_decode_tokens, n_tokens
+            )
+        )
 
 
 PRESETS: dict[str, ModelConfig] = {

@@ -315,7 +315,7 @@ def _mlp(
     if not cfg.is_moe:
         y = swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
         return (y, _zero_aux()) if collect_aux else y
-    if cfg.moe_capacity_factor > 0:
+    if not cfg.moe_dense_at(h.shape[0] * h.shape[1]):
         return _moe_dispatch(cfg, p, h, collect_aux=collect_aux)
     # Mixtral MoE: top-k routing, dense all-experts compute, weighted combine.
     router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, S, E]
@@ -1080,6 +1080,23 @@ def prefill_chunked(
     exactness-tested against it.
     """
     b, s = tokens.shape
+    if cfg.is_moe and cfg.moe_capacity_factor > 0:
+        # Pin each chunk's MoE dispatch path to the one a ONE-SHOT
+        # prefill of this prompt would trace (the b*s total decides),
+        # not the chunk's own token count — otherwise a prompt above
+        # the dense-fallback threshold whose chunks sit below it would
+        # mix paths across the two prefill entry points. b*chunk (not
+        # b*s) on the dense side: padding can widen a chunk past s.
+        # (When capacity binds, chunked capacity dispatch is still
+        # approximate vs one-shot — capacity is bounded per chunk,
+        # standard GShard semantics; the exactness contract below is
+        # bitwise only when no token exceeds capacity, as with dense or
+        # generous factors.)
+        cfg = (
+            cfg.with_moe_dense_up_to(b * chunk)
+            if cfg.moe_dense_at(b * s)
+            else cfg.with_moe_capacity_pinned()
+        )
     if s % chunk:
         pad = chunk - s % chunk
         tokens = jnp.pad(tokens, ((0, 0), (0, pad)))

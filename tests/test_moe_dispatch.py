@@ -14,7 +14,10 @@ from llm_consensus_tpu.models.transformer import forward, init_params
 from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
 from llm_consensus_tpu.parallel.partitioning import shard_params
 
-CFG = get_config("test-tiny-moe")
+# moe_dense_decode_tokens=0 pins the capacity path: these tests run at
+# tiny token counts, below the default trace-time dense-fallback
+# threshold (configs.ModelConfig.moe_dense_decode_tokens).
+CFG = get_config("test-tiny-moe").with_(moe_dense_decode_tokens=0)
 
 
 def _setup():
@@ -57,6 +60,23 @@ def test_dispatch_shards_over_expert_axis(cpu_devices):
     out = forward(cfg, sharded, tokens)
     ref = forward(cfg, params, tokens)
     assert float(jnp.max(jnp.abs(out - ref))) < 1e-4
+
+
+def test_dense_fallback_below_token_threshold():
+    """Decode-shape auto-switch: with T <= moe_dense_decode_tokens a
+    capacity config takes the dense path (no capacity drops), observable
+    by starving capacity — cf=0.01 with the dispatch pinned drops nearly
+    every token, while the auto-switched output equals plain dense."""
+    params, tokens = _setup()  # T = 32 tokens
+    dense = forward(CFG.with_(moe_capacity_factor=0.0), params, tokens)
+    auto = forward(
+        CFG.with_(moe_capacity_factor=0.01, moe_dense_decode_tokens=256),
+        params,
+        tokens,
+    )
+    pinned = forward(CFG.with_(moe_capacity_factor=0.01), params, tokens)
+    assert float(jnp.max(jnp.abs(auto - dense))) < 1e-6
+    assert float(jnp.max(jnp.abs(pinned - dense))) > 1e-3
 
 
 def test_dispatch_grad_flows():
