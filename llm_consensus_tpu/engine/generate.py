@@ -422,20 +422,20 @@ def _prefix_prefill_impl(
     # self-consistency fan-out) — run the suffix chunk once at B=1 and
     # broadcast, like generate()'s shared_prefill.
     cb = 1 if shared_suffix else b
-    if cfg.is_moe and cfg.moe_capacity_factor > 0:
-        # Align the suffix chunk's MoE dispatch path with the one a
-        # plain one-shot prefill of the CONCATENATED prompt would trace
-        # at this batch: generate_from_prefix is exactness-tested
-        # against generate(), and the prefix+suffix split must not flip
-        # the suffix onto the other side of the trace-time dense
-        # fallback. The suffix chunk itself is <= the concatenated
-        # total, so the dense side needs no threshold raise. (When
-        # capacity genuinely binds, capacity dispatch stays approximate
-        # across program shapes — per-program capacity, GShard
-        # semantics — so the bitwise contract holds on the dense side
-        # and at generous capacity factors.)
-        if not cfg.moe_dense_at(cb * (prefix_k.shape[2] + s)):
-            cfg = cfg.with_moe_capacity_pinned()
+    # Align the suffix chunk's MoE dispatch path with the one a plain
+    # one-shot prefill of the CONCATENATED prompt would trace at this
+    # batch: generate_from_prefix is exactness-tested against
+    # generate(), and the prefix+suffix split must not flip the suffix
+    # onto the other side of the trace-time dense fallback. Two
+    # approximations, both near the threshold only: the true prefix
+    # length is traced data, so the comparison uses the pow2 BUCKET
+    # width prefix_k.shape[2] (>= real length — near-threshold prompts
+    # can pin capacity where plain ran dense), and on the capacity side
+    # per-program capacity still drops differently than one-shot
+    # (ModelConfig.moe_pin_for). Away from the threshold and at
+    # generous capacity factors the contract is bitwise.
+    total = cb * (prefix_k.shape[2] + s)
+    cfg = cfg.moe_pin_for(total, total)
     plen = jnp.asarray(prefix_len, jnp.int32)
     if kv_quant:
         qcache = QuantKVCache.create(cfg, cb, cache_len)

@@ -158,23 +158,18 @@ def speculative_generate(
     b, s = tokens.shape
     # Prefill keeps the CALLER's config: spec's prefill runs the same
     # [B, S] one-shot program shape as the plain path's, so the same
-    # cfg yields the same trace-time MoE dispatch choice there. Only
-    # the decode-side programs need a pin (below).
+    # cfg yields the same trace-time MoE dispatch choice there. The
+    # decode-side programs (draft steps + verify chunks) pin to the
+    # path the plain decode step (b tokens) would take — the verify
+    # chunk's b*(k_spec+1) tokens could otherwise land on the other
+    # side of the dense-fallback threshold and break greedy
+    # token-identity with the plain path. The pin aligns the PATH
+    # only: on the capacity side, per-program capacity means the
+    # verify chunk and the plain step can still drop different tokens
+    # when capacity genuinely binds (ModelConfig.moe_pin_for) — greedy
+    # identity for capacity-MoE targets holds when nothing drops.
     cfg_t_prefill = cfg_t
-    if cfg_t.is_moe and cfg_t.moe_capacity_factor > 0:
-        # The verify chunk (b*(k_spec+1) tokens) and the plain decode
-        # step (b tokens) sit on opposite sides of the trace-time MoE
-        # dense-fallback threshold for mid-sized batches; the two
-        # dispatch paths differ numerically when capacity binds, which
-        # would break spec's greedy token-identity with the plain path.
-        # Pin the decode-side programs (draft steps + verify chunks) to
-        # the path the plain decode step would take: all-dense when b
-        # is at/below the threshold, all-capacity otherwise.
-        cfg_t = (
-            cfg_t.with_moe_dense_up_to(b * (k_spec + 1))
-            if cfg_t.moe_dense_at(b)
-            else cfg_t.with_moe_capacity_pinned()
-        )
+    cfg_t = cfg_t.moe_pin_for(b, b * (k_spec + 1))
     if cache_len is None:
         # +k_spec+1 slack: a chunk may write past the last emitted slot.
         cache_len = s + max_new_tokens + k_spec + 1

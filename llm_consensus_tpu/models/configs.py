@@ -115,6 +115,29 @@ class ModelConfig:
             )
         )
 
+    def moe_pin_for(
+        self, ref_tokens: int, dense_up_to: int
+    ) -> "ModelConfig":
+        """Pin the dispatch path for a FAMILY of programs to the choice
+        a reference program of ``ref_tokens`` tokens makes: dense for
+        every program up to ``dense_up_to`` tokens when the reference
+        side is dense, capacity at every shape otherwise. No-op for
+        non-MoE / capacity-disabled configs.
+
+        Pinning aligns the PATH only. When capacity genuinely binds,
+        capacity dispatch remains approximate across program shapes
+        (capacity C = ceil(T*k/E*factor) is per-program, so programs of
+        different T can drop different tokens — GShard semantics);
+        bitwise cross-program contracts hold on the dense side and at
+        capacity factors generous enough that nothing drops."""
+        if not (self.is_moe and self.moe_capacity_factor > 0):
+            return self
+        return (
+            self.with_moe_dense_up_to(dense_up_to)
+            if self.moe_dense_at(ref_tokens)
+            else self.with_moe_capacity_pinned()
+        )
+
 
 PRESETS: dict[str, ModelConfig] = {
     # North-star flagship (BASELINE.json).

@@ -1080,23 +1080,14 @@ def prefill_chunked(
     exactness-tested against it.
     """
     b, s = tokens.shape
-    if cfg.is_moe and cfg.moe_capacity_factor > 0:
-        # Pin each chunk's MoE dispatch path to the one a ONE-SHOT
-        # prefill of this prompt would trace (the b*s total decides),
-        # not the chunk's own token count — otherwise a prompt above
-        # the dense-fallback threshold whose chunks sit below it would
-        # mix paths across the two prefill entry points. b*chunk (not
-        # b*s) on the dense side: padding can widen a chunk past s.
-        # (When capacity binds, chunked capacity dispatch is still
-        # approximate vs one-shot — capacity is bounded per chunk,
-        # standard GShard semantics; the exactness contract below is
-        # bitwise only when no token exceeds capacity, as with dense or
-        # generous factors.)
-        cfg = (
-            cfg.with_moe_dense_up_to(b * chunk)
-            if cfg.moe_dense_at(b * s)
-            else cfg.with_moe_capacity_pinned()
-        )
+    # Pin each chunk's MoE dispatch path to the one a ONE-SHOT prefill
+    # of this prompt would trace (the b*s total decides), not the
+    # chunk's own token count — otherwise a prompt above the
+    # dense-fallback threshold whose chunks sit below it would mix
+    # paths across the two prefill entry points. Dense side covers
+    # b*chunk: padding can widen a chunk past s. Residual capacity-side
+    # caveat: ModelConfig.moe_pin_for.
+    cfg = cfg.moe_pin_for(b * s, b * chunk)
     if s % chunk:
         pad = chunk - s % chunk
         tokens = jnp.pad(tokens, ((0, 0), (0, pad)))

@@ -318,6 +318,32 @@ def test_engine_mesh_sharded_self_consistency_matches_single_device(tiny):
     assert r_sharded.vote.winner == r_single.vote.winner
 
 
+def test_engine_mesh_moe_capacity_matches_single_device():
+    """An MoE engine on a data x expert mesh, capacity dispatch pinned
+    (the dispatch einsums become GSPMD all-to-alls over `expert`), must
+    decode the same greedy tokens as the unsharded engine. Capacity
+    factor = E so no token can drop (the exactness anchor); greedy so
+    EP's collective reduction order (fp32 noise ~1e-6) can't flip a
+    sampled near-tie."""
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    base = get_config("test-tiny-moe")
+    cfg = base.with_(
+        moe_capacity_factor=float(base.n_experts)
+    ).with_moe_capacity_pinned()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ecfg = EngineConfig(
+        max_new_tokens=6, seq_buckets=(16,), batch_buckets=(1, 2, 4)
+    )
+    mesh = make_mesh(MeshConfig(data=2, expert=4))
+    single = InferenceEngine(cfg, params, engine_config=ecfg)
+    sharded = InferenceEngine(cfg, params, engine_config=ecfg, mesh=mesh)
+    prompts = ["2+2=", "3+3="]
+    a = single.generate_texts(prompts, temperatures=[0.0, 0.0], seed=5)
+    b = sharded.generate_texts(prompts, temperatures=[0.0, 0.0], seed=5)
+    assert [r.text for r in a] == [r.text for r in b]
+
+
 def test_engine_mesh_batch_buckets_respect_data_axis(tiny):
     """A dp=8 mesh drops batch buckets that don't tile the data axis."""
     from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
