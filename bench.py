@@ -17,11 +17,73 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+
+# The ONE probe body, run both in-process (_chip_responsive, via exec)
+# and as a subprocess (_await_chip). Salted operand: the tunnel replays
+# previously-seen (executable, inputs) pairs across processes — a fixed
+# probe could "pass" from the replay cache with the chip dead (the
+# half-up state the salt exists to catch). Host fetch (np.asarray), not
+# block_until_ready: the only sync the tunnel runtime cannot fake.
+_PROBE_SRC = """
+import time
+import jax, numpy as np, jax.numpy as jnp
+jax.devices()
+salt = float(int(time.time() * 1e6) % 9973)
+x = jnp.ones((8, 8)).at[0, 0].set(salt)
+v = np.asarray(x @ jnp.ones((8, 8)))
+assert v.shape == (8, 8)
+"""
+
+
+def _await_chip(budget_s: float, probe_timeout_s: float = 90.0) -> bool:
+    """Retry the preflight in SUBPROCESSES until the chip answers or the
+    budget expires.
+
+    Retrying in-process cannot work: when the tunnel's remote side is
+    down, ``jax.devices()`` either hangs (wedging the backend-init lock
+    for every later attempt in this process) or raises after a long
+    internal stall. A child process is abandonable and leaves this
+    process's JAX state untouched until a probe has actually succeeded.
+    Bridges short outages so a driver-invoked bench records a number
+    instead of 0.0 (round-4's official record); budget via
+    BENCH_CHIP_WAIT_S, default 600 s — a multi-hour outage still fails.
+    """
+    import subprocess
+
+    deadline = time.time() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                timeout=probe_timeout_s,
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+            err = (r.stderr or b"").decode(errors="replace").strip()
+            print(
+                f"[bench] chip probe attempt {attempt} rc={r.returncode}"
+                + (f": {err.splitlines()[-1]}" if err else ""),
+                file=sys.stderr,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"[bench] chip probe attempt {attempt} timed out "
+                f"({probe_timeout_s:.0f}s)",
+                file=sys.stderr,
+            )
+        if time.time() >= deadline:
+            return False
+        time.sleep(45.0)
 
 
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
@@ -40,14 +102,7 @@ def _chip_responsive(timeout_s: float = 180.0) -> bool:
 
     def probe():
         try:
-            jax.devices()
-            # Salted operand: the tunnel replays previously-seen
-            # (executable, inputs) pairs across processes — a fixed
-            # probe could "pass" from the replay cache with the chip
-            # dead (the half-up state this matmul exists to catch).
-            salt = float(int(time.time() * 1e6) % 9973)
-            x = jnp.ones((8, 8)).at[0, 0].set(salt)
-            jax.block_until_ready(x @ jnp.ones((8, 8)))
+            exec(_PROBE_SRC, {})  # noqa: S102 - the shared probe body
             ok.append(True)
         except Exception as e:  # noqa: BLE001 - any failure = unresponsive
             print(f"[bench] chip probe raised: {e!r}", file=sys.stderr)
@@ -159,10 +214,22 @@ def main() -> int:
             moe_capacity_factor=cfg.moe_capacity_factor or 1.25,
         )
     probe_timeout = 180.0
-    if not args.cpu and not _chip_responsive(probe_timeout):
+    try:
+        wait_budget = float(os.environ.get("BENCH_CHIP_WAIT_S", "600"))
+    except ValueError:
+        print(
+            "[bench] malformed BENCH_CHIP_WAIT_S "
+            f"{os.environ['BENCH_CHIP_WAIT_S']!r}; using 600",
+            file=sys.stderr,
+        )
+        wait_budget = 600.0
+    if not args.cpu and not (
+        _await_chip(wait_budget) and _chip_responsive(probe_timeout)
+    ):
         # The tunneled chip can go unreachable for hours (observed
         # mid-round-4); a bench that hangs forever is worse than an
-        # explicit failure record.
+        # explicit failure record. _await_chip bridges short outages
+        # first (subprocess probes, BENCH_CHIP_WAIT_S budget).
         print(
             json.dumps(
                 {
@@ -179,8 +246,6 @@ def main() -> int:
         )
         # _exit, not return: the JAX runtime's shutdown hooks block on
         # the same dead tunnel the probe just diagnosed.
-        import os
-
         os._exit(2)
     dev = jax.devices()[0]
     # Fused Pallas kernels are single-chip TPU only (pallas_call is

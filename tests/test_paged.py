@@ -231,6 +231,41 @@ def test_continuous_matches_engine_greedy(batcher):
     assert got == want
 
 
+def test_continuous_moe_matches_engine_greedy():
+    """An MoE model (Mixtral-style capacity config) serves through the
+    continuous batcher and matches the engine path exactly: at serving
+    shapes every program sits at/below the dense-fallback threshold
+    (ModelConfig.moe_dense_decode_tokens), so both substrates trace the
+    dense all-experts path consistently."""
+    cfg = get_config("test-tiny-moe").with_(moe_capacity_factor=1.25)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    b = ContinuousBatcher(
+        cfg,
+        params,
+        config=ContinuousConfig(
+            max_slots=4,
+            page_size=16,
+            n_pages=64,
+            pages_per_seq=8,
+            max_new_tokens=8,
+            seq_buckets=(16, 32, 64),
+        ),
+    )
+    try:
+        prompts = ["hello world", "abc"]
+        futures = [b.submit(p, max_new_tokens=8) for p in prompts]
+        got = [f.result(timeout=120).text for f in futures]
+    finally:
+        b.close()
+    eng = InferenceEngine(
+        cfg,
+        params,
+        engine_config=EngineConfig(max_new_tokens=8, seq_buckets=(16, 32, 64)),
+    )
+    want = [r.text for r in eng.generate_texts(prompts, max_new_tokens=8)]
+    assert got == want
+
+
 def test_backend_stop_parity_local_vs_continuous(batcher):
     """Protocol matrix with stops: LocalBackend (engine path) and
     ContinuousBackend must serve IDENTICAL text for the same greedy
