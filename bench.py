@@ -214,8 +214,12 @@ def main() -> int:
             moe_capacity_factor=cfg.moe_capacity_factor or 1.25,
         )
     probe_timeout = 180.0
+    import math
+
     try:
         wait_budget = float(os.environ.get("BENCH_CHIP_WAIT_S", "600"))
+        if not math.isfinite(wait_budget) or wait_budget < 0:
+            raise ValueError(wait_budget)
     except ValueError:
         print(
             "[bench] malformed BENCH_CHIP_WAIT_S "
@@ -233,10 +237,11 @@ def main() -> int:
         print(
             json.dumps(
                 {
-                    "metric": "CHIP UNREACHABLE (preflight device "
-                    "discovery + matmul did not complete in "
-                    f"{probe_timeout:.0f}s; raised errors, if any, are "
-                    "on stderr)",
+                    "metric": "CHIP UNREACHABLE (subprocess probes "
+                    f"failed for the {wait_budget:.0f}s wait budget "
+                    "and/or the in-process preflight did not complete "
+                    f"in {probe_timeout:.0f}s; per-attempt errors on "
+                    "stderr)",
                     "value": 0.0,
                     "unit": "tokens/sec/chip",
                     "vs_baseline": 0.0,

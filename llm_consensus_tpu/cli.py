@@ -233,9 +233,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--eval-gsm8k",
         default=None,
-        metavar="JSONL|bundled|synthetic",
+        metavar="JSONL|bundled|synthetic|synthetic2",
         help="run the GSM8K EM harness on a JSONL file, the bundled "
-        "50-problem dataset (eval/data/gsm8k_mini.jsonl), or 'synthetic'",
+        "50-problem dataset (eval/data/gsm8k_mini.jsonl), 'synthetic' "
+        "(single-template arithmetic), or 'synthetic2' (the hard "
+        "multi-step multi-template task, eval/arith2.py)",
     )
     p.add_argument("--eval-n", type=int, default=8, help="candidates per problem")
     p.add_argument("--eval-limit", type=int, default=20)
@@ -446,6 +448,14 @@ def _run_eval(args) -> int:
     backend = _build_backend(args)
     if args.eval_gsm8k == "synthetic":
         problems = synthetic_problems(args.eval_limit)
+    elif args.eval_gsm8k == "synthetic2":
+        # The hard offline task (eval/arith2.py): multi-step chains,
+        # six narrative frames, distractors — serve an arith2-trained
+        # checkpoint (--checkpoint runs/arith25m --model arith-25m)
+        # and measure EM-vs-N from the same CLI the REPL uses.
+        from llm_consensus_tpu.eval.arith2 import eval_problems
+
+        problems, _ = eval_problems(args.eval_limit)
     elif args.eval_gsm8k == "bundled":
         import llm_consensus_tpu.eval as _eval_pkg
 

@@ -122,6 +122,29 @@ def test_eval_bundled_dataset_with_local_backend(capsys):
     assert report["n_candidates"] == 2
 
 
+def test_eval_synthetic2_hard_task(capsys):
+    """--eval-gsm8k synthetic2 runs the multi-step arith2 task through
+    a (random-weight) local engine — CLI surface for the hard corpus."""
+    import json
+
+    from llm_consensus_tpu.cli import main
+
+    rc = main(
+        [
+            "--backend", "local",
+            "--model", "test-tiny",
+            "--eval-gsm8k", "synthetic2",
+            "--eval-n", "2",
+            "--eval-limit", "2",
+            "--max-new-tokens", "4",
+        ]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["n_problems"] == 2
+    assert report["n_candidates"] == 2
+
+
 def test_cli_mesh_flag_shards_engine(capsys):
     """--mesh data=8 answers a one-shot question on a sharded engine."""
     from llm_consensus_tpu.cli import main
