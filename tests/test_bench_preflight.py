@@ -1,0 +1,47 @@
+"""bench.py chip preflight (_await_chip subprocess probe loop).
+
+The real failure modes (hung jax.devices(), UNAVAILABLE backend init)
+were driven live against a down tunnel (round 5); these tests pin the
+loop's budget/retry contract with stubbed probe bodies so the logic
+stays testable offline.
+"""
+
+import time
+
+import bench
+
+
+def test_await_chip_success_first_probe(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC", "pass")
+    t0 = time.perf_counter()
+    assert bench._await_chip(budget_s=30, probe_timeout_s=10) is True
+    assert time.perf_counter() - t0 < 10  # no retry sleep on success
+
+
+def test_await_chip_budget_expires_on_failing_probe(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_PROBE_SRC", "import sys; sys.exit(1)"
+    )
+    # Patch the retry sleep: on a fast machine the first probe can
+    # finish inside the budget, which would otherwise hit the real
+    # 45 s sleep before the deadline check fails the next attempt.
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._await_chip(budget_s=0.5, probe_timeout_s=10) is False
+
+
+def test_await_chip_retries_until_budget(monkeypatch, tmp_path):
+    """A probe that fails once then succeeds: the loop sleeps and
+    retries within budget. The probe flips state via a marker file."""
+    marker = tmp_path / "flip"
+    src = (
+        "import pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "if m.exists():\n"
+        "    sys.exit(0)\n"
+        "m.write_text('x')\n"
+        "sys.exit(1)\n"
+    )
+    monkeypatch.setattr(bench, "_PROBE_SRC", src)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._await_chip(budget_s=300, probe_timeout_s=10) is True
+    assert marker.exists()
