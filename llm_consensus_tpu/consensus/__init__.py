@@ -18,6 +18,7 @@ from llm_consensus_tpu.consensus.debate import (
     DebateConfig,
     DebateResult,
     run_debate,
+    run_panel_debate,
 )
 from llm_consensus_tpu.consensus.voting import (
     VoteResult,
@@ -45,6 +46,7 @@ __all__ = [
     "rescore_vote",
     "majority_vote",
     "run_debate",
+    "run_panel_debate",
     "save_panel",
     "self_consistency",
     "weighted_vote",

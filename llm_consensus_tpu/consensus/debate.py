@@ -91,6 +91,31 @@ _REVISE = (
 )
 
 
+def _checked_templates(
+    cfg: DebateConfig, question: str
+) -> tuple[str, str]:
+    """Resolve + dry-run both templates (fail-fast invariant): a typo'd
+    placeholder or a literal brace in a custom format must not surface
+    only at round-2 prompt build, after an N-candidate device round has
+    already been spent — and an initial template that drops {q} would
+    debate a question-free prompt."""
+    initial_t = cfg.initial_template or _INITIAL
+    revise_t = cfg.revise_template or _REVISE
+    try:
+        probe = initial_t.format(q=question)
+        revise_t.format(i=0, q=question, own="x", peers="y")
+    except (KeyError, IndexError, ValueError) as e:
+        raise ValueError(
+            f"bad debate template (unknown placeholder or literal "
+            f"brace? escape literals as {{{{...}}}}): {e!r}"
+        ) from e
+    if question not in probe:
+        raise ValueError(
+            "initial_template must embed the question via {q}"
+        )
+    return initial_t, revise_t
+
+
 def run_debate(
     engine,
     question: str,
@@ -116,26 +141,7 @@ def run_debate(
     n = cfg.n_candidates
     rounds: list[DebateRound] = []
     total_tokens = 0
-    initial_t = cfg.initial_template or _INITIAL
-    revise_t = cfg.revise_template or _REVISE
-    # Dry-run BOTH templates now (same fail-fast invariant as the
-    # method checks above): a typo'd placeholder or a literal brace in
-    # a custom format must not surface only at round-2 prompt build,
-    # after an N-candidate device round has already been spent — and an
-    # initial template that drops {q} would debate a question-free
-    # prompt.
-    try:
-        probe = initial_t.format(q=question)
-        revise_t.format(i=0, q=question, own="x", peers="y")
-    except (KeyError, IndexError, ValueError) as e:
-        raise ValueError(
-            f"bad debate template (unknown placeholder or literal "
-            f"brace? escape literals as {{{{...}}}}): {e!r}"
-        ) from e
-    if question not in probe:
-        raise ValueError(
-            "initial_template must embed the question via {q}"
-        )
+    initial_t, revise_t = _checked_templates(cfg, question)
 
     prompts = [initial_t.format(q=question)] * n
     answers: list[str] = []
@@ -179,6 +185,103 @@ def run_debate(
                 )
                 for i in range(n)
             ]
+
+    final = rounds[-1].vote
+    return DebateResult(
+        answer=final.text,
+        vote=final,
+        rounds=rounds,
+        total_tokens=total_tokens,
+    )
+
+
+def run_panel_debate(
+    engines: dict[str, tuple[object, float]],
+    question: str,
+    config: DebateConfig | None = None,
+    key_fn=canonicalize,
+) -> DebateResult:
+    """Multi-MODEL debate: a heterogeneous panel (BASELINE config[3])
+    debating through iterative re-vote rounds (config[4]).
+
+    ``engines``: member name -> (engine, vote weight) — the same
+    signature as :func:`~llm_consensus_tpu.consensus.voting.
+    heterogeneous_panel_vote`. Each round, every member samples
+    ``n_candidates`` with its OWN engine (one batched program per
+    member; members fan out concurrently, and seeds are per-(round,
+    member) so results are order-independent), and every candidate
+    votes with its member's weight. Revision prompts draw peers from
+    the POOLED answer set, so a strong member's answers reach weaker
+    members' contexts — cross-model debate on local engines, which the
+    reference's single-shared-answer refine loop
+    (``src/main.rs:268-286``) cannot express.
+
+    Votes are weighted-majority only: sequence logprobs are not
+    calibrated ACROSS different models, so ``logit_pool``/``rescore``
+    would let one member's logit scale dominate the pool.
+    """
+    cfg = config or DebateConfig()
+    if cfg.method != "majority":
+        raise ValueError(
+            "panel debate votes by weighted majority; logprob-based "
+            "methods are not calibrated across different models"
+        )
+    from llm_consensus_tpu.consensus.voting import (
+        _panel_fanout,
+        weighted_vote,
+    )
+
+    ordered = sorted(engines.items())
+    if not ordered:
+        raise ValueError("panel debate needs at least one engine")
+    n = cfg.n_candidates
+    initial_t, revise_t = _checked_templates(cfg, question)
+
+    member_prompts = {
+        name: [initial_t.format(q=question)] * n for name, _ in ordered
+    }
+    rounds: list[DebateRound] = []
+    total_tokens = 0
+    for r in range(cfg.max_rounds):
+        outs = _panel_fanout(
+            ordered,
+            member_prompts.__getitem__,
+            cfg.temperature,
+            lambda mi: cfg.seed + r * len(ordered) + mi,
+            cfg.max_new_tokens,
+        )
+        answers: list[str] = []
+        weights: list[float] = []
+        for _name, weight, res in outs:  # sorted-name order preserved
+            answers.extend(x.text for x in res)
+            weights.extend([weight] * len(res))
+            total_tokens += sum(x.num_tokens for x in res)
+        vote = weighted_vote(answers, weights, key_fn)
+        rounds.append(DebateRound(answers=answers, vote=vote))
+        # Quorum measures HEADCOUNT agreement, not the weighted tally —
+        # the same invariant run_debate documents: a single heavy
+        # member must not end the debate unilaterally while most
+        # models still disagree (the cross-model exchange is the point).
+        heads = majority_vote(answers, key_fn)
+        lead = max(heads.tally.values()) / max(
+            sum(heads.tally.values()), 1e-9
+        )
+        if lead >= cfg.quorum:
+            break
+        if r + 1 < cfg.max_rounds:
+            for bi, (name, _) in enumerate(ordered):
+                base = bi * n
+                member_prompts[name] = [
+                    revise_t.format(
+                        i=base + i,
+                        q=question,
+                        own=answers[base + i],
+                        peers=_peer_digest(
+                            answers, base + i, cfg.peer_sample
+                        ),
+                    )
+                    for i in range(n)
+                ]
 
     final = rounds[-1].vote
     return DebateResult(

@@ -234,6 +234,42 @@ class PanelVoteResult:
     total_tokens: int
 
 
+def _panel_fanout(
+    ordered: list[tuple[str, tuple[object, float]]],
+    prompts_for,
+    temperature: float,
+    seed_for,
+    max_new_tokens: int | None,
+):
+    """Concurrent per-member sampling shared by
+    :func:`heterogeneous_panel_vote` and
+    :func:`~llm_consensus_tpu.consensus.debate.run_panel_debate`.
+
+    One thread per engine: on a single shared chip the calls still
+    serialize on the device queue, but engines on disjoint meshes/hosts
+    overlap fully, and even single-chip panels overlap each model's
+    host-side tokenize/detokenize work. ``seed_for(member_index)`` gives
+    each member its own seed, so results are identical to the
+    sequential path regardless of completion order. Returns
+    ``[(name, weight, results)]`` in the input (sorted-name) order.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    def _one(arg):
+        mi, (name, (engine, weight)) = arg
+        prompts = prompts_for(name)
+        results = engine.generate_texts(
+            prompts,
+            temperatures=[temperature] * len(prompts),
+            seed=seed_for(mi),
+            max_new_tokens=max_new_tokens,
+        )
+        return name, weight, results
+
+    with ThreadPoolExecutor(max_workers=max(1, len(ordered))) as ex:
+        return list(ex.map(_one, enumerate(ordered)))
+
+
 def heterogeneous_panel_vote(
     engines: dict[str, tuple[object, float]],
     prompt: str,
@@ -250,30 +286,19 @@ def heterogeneous_panel_vote(
     have different weights/meshes so they cannot share a batch); every
     candidate votes with its model's weight.
 
-    The per-model calls run CONCURRENTLY (one thread per engine): on a
-    single shared chip they still serialize on the device queue, but
-    engines on disjoint meshes/hosts — the deployment config[3]
-    describes — overlap fully, and even single-chip panels overlap each
-    model's host-side tokenize/detokenize work. Seeds are per-model
-    (seed + model index in sorted-name order), so results are identical
-    to the sequential path regardless of completion order.
+    The per-model calls run CONCURRENTLY via :func:`_panel_fanout`
+    (one thread per engine; per-model seeds = seed + model index in
+    sorted-name order) — the deployment config[3] describes engines on
+    disjoint meshes/hosts, which overlap fully.
     """
-    from concurrent.futures import ThreadPoolExecutor
-
     ordered = sorted(engines.items())
-
-    def _one(mi_name_ew):
-        mi, (name, (engine, weight)) = mi_name_ew
-        results = engine.generate_texts(
-            [prompt] * n_per_model,
-            temperatures=[temperature] * n_per_model,
-            seed=seed + mi,
-            max_new_tokens=max_new_tokens,
-        )
-        return name, weight, results
-
-    with ThreadPoolExecutor(max_workers=max(1, len(ordered))) as ex:
-        outs = list(ex.map(_one, enumerate(ordered)))
+    outs = _panel_fanout(
+        ordered,
+        lambda _name: [prompt] * n_per_model,
+        temperature,
+        lambda mi: seed + mi,
+        max_new_tokens,
+    )
 
     answers: list[str] = []
     weights: list[float] = []

@@ -66,6 +66,68 @@ def test_debate_revision_prompts_carry_peers():
     assert res.vote.winner == "b"  # unanimity after revision
 
 
+def test_panel_debate_weighted_majority_and_cross_model_peers():
+    """run_panel_debate: a heavy member's answer wins the weighted vote
+    even when outnumbered, and revision prompts show candidates peers
+    from OTHER members' answer pools."""
+    from llm_consensus_tpu.consensus.debate import run_panel_debate
+
+    strong = FakeEngine([["X", "X"], ["X", "X"]])
+    weak = FakeEngine([["Y", "Y"], ["Y", "Y"]])
+    res = run_panel_debate(
+        {"strong": (strong, 3.0), "weak": (weak, 1.0)},
+        "The question",
+        DebateConfig(n_candidates=2, max_rounds=2, quorum=0.9),
+    )
+    # Weighted tally: X = 2*3 = 6, Y = 2*1 = 2 -> X wins; 6/8 < 0.9
+    # quorum so a second round runs.
+    assert res.n_rounds == 2
+    assert res.vote.winner == "x"
+    assert res.total_tokens == 8  # 1 token x 2 cand x 2 members x 2 rounds
+    # The weak member's round-2 prompts carry the strong member's answer.
+    assert any("X" in p for p in weak.calls[1])
+    assert all("The question" in p for p in weak.calls[1])
+
+
+def test_panel_debate_quorum_is_headcount_not_weighted():
+    """A single heavy member must not end the debate unilaterally: the
+    weighted tally picks the WINNER, but the quorum early-exit measures
+    headcount agreement (the run_debate invariant)."""
+    from llm_consensus_tpu.consensus.debate import run_panel_debate
+
+    heavy = FakeEngine([["A", "A"], ["A", "A"]])
+    light = FakeEngine([["B", "B"], ["B", "B"]])
+    res = run_panel_debate(
+        {"heavy": (heavy, 9.0), "light": (light, 1.0)},
+        "Q",
+        DebateConfig(n_candidates=2, max_rounds=2, quorum=0.75),
+    )
+    # Weighted lead 18/20 = 0.9 >= quorum, but headcount is 2/4 = 0.5:
+    # the revision round must still run.
+    assert res.n_rounds == 2
+    assert res.vote.winner == "a"  # weighted vote still picks A
+
+
+def test_panel_debate_quorum_early_exit_and_method_guard():
+    from llm_consensus_tpu.consensus.debate import run_panel_debate
+
+    a = FakeEngine([["7", "7"]])
+    b = FakeEngine([["7", "7"]])
+    res = run_panel_debate(
+        {"a": (a, 1.0), "b": (b, 2.0)},
+        "Q",
+        DebateConfig(n_candidates=2, max_rounds=3, quorum=0.75),
+    )
+    assert res.n_rounds == 1  # unanimity -> early exit
+    assert len(a.calls) == 1 and len(b.calls) == 1
+    with pytest.raises(ValueError, match="weighted majority"):
+        run_panel_debate(
+            {"a": (a, 1.0)}, "Q", DebateConfig(method="logit_pool")
+        )
+    with pytest.raises(ValueError, match="at least one"):
+        run_panel_debate({}, "Q", DebateConfig())
+
+
 def test_debate_on_real_tiny_engine():
     from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
     from llm_consensus_tpu.models.configs import get_config
