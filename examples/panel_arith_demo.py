@@ -32,7 +32,11 @@ import jax
 import jax.numpy as jnp
 
 from llm_consensus_tpu.checkpoint.io import restore_params_for_inference
-from llm_consensus_tpu.consensus.voting import heterogeneous_panel_vote
+from llm_consensus_tpu.consensus.debate import DebateConfig, run_panel_debate
+from llm_consensus_tpu.consensus.voting import (
+    extract_final_number,
+    heterogeneous_panel_vote,
+)
 from llm_consensus_tpu.engine.engine import EngineConfig, InferenceEngine
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
 from llm_consensus_tpu.eval.arith import eval_split
@@ -60,6 +64,16 @@ def main() -> int:
     p.add_argument("--n-per-model", type=int, default=4)
     p.add_argument("--temperature", type=float, default=0.7)
     p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument(
+        "--debate",
+        type=int,
+        default=0,
+        metavar="ROUNDS",
+        help="run run_panel_debate (cross-model debate with weighted "
+        "vote + headcount quorum) for up to ROUNDS rounds per question "
+        "instead of the single-round heterogeneous_panel_vote",
+    )
+    p.add_argument("--quorum", type=float, default=0.9)
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
     if args.cpu:
@@ -102,19 +116,47 @@ def main() -> int:
     import time
 
     latencies = []
+    rounds_taken = []
     for i, prob in enumerate(problems):
         t0 = time.perf_counter()
-        res = heterogeneous_panel_vote(
-            engines,
-            _PROMPT.format(q=prob.question),
-            n_per_model=args.n_per_model,
-            temperature=args.temperature,
-            seed=100 + i,
-            max_new_tokens=args.max_new_tokens,
-        )
+        if args.debate:
+            # Narrow SFT members answer reliably only in their trained
+            # format; peers arrive as leading context (the
+            # debate_arith_eval.py convention).
+            dres = run_panel_debate(
+                engines,
+                prob.question,
+                DebateConfig(
+                    n_candidates=args.n_per_model,
+                    max_rounds=args.debate,
+                    temperature=args.temperature,
+                    quorum=args.quorum,
+                    max_new_tokens=args.max_new_tokens,
+                    seed=100 + i,
+                    initial_template=_PROMPT,
+                    revise_template=(
+                        "Other attempts at this problem answered: "
+                        "{peers}\n\n" + _PROMPT
+                    ),
+                ),
+                key_fn=lambda t: extract_final_number(t) or "<none>",
+            )
+            rounds_taken.append(dres.n_rounds)
+            total_tokens += dres.total_tokens
+            winner = dres.vote.winner
+        else:
+            res = heterogeneous_panel_vote(
+                engines,
+                _PROMPT.format(q=prob.question),
+                n_per_model=args.n_per_model,
+                temperature=args.temperature,
+                seed=100 + i,
+                max_new_tokens=args.max_new_tokens,
+            )
+            total_tokens += res.total_tokens
+            winner = res.vote.winner
         latencies.append(time.perf_counter() - t0)
-        total_tokens += res.total_tokens
-        ok = exact_match(res.vote.winner, prob.answer)
+        ok = exact_match(winner, prob.answer)
         correct += ok
     steady = sorted(latencies[1:]) or latencies
     out = {
@@ -122,6 +164,11 @@ def main() -> int:
         "weights": weights,
         "n_problems": args.n_problems,
         "n_per_model": args.n_per_model,
+        "debate_rounds": (
+            round(sum(rounds_taken) / len(rounds_taken), 2)
+            if rounds_taken
+            else None
+        ),
         "em": round(correct / max(1, args.n_problems), 4),
         "total_candidate_tokens": total_tokens,
         "first_question_s": round(latencies[0], 3) if latencies else None,

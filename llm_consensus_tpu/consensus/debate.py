@@ -91,6 +91,39 @@ _REVISE = (
 )
 
 
+def _quorum_reached(answers, key_fn, quorum: float) -> bool:
+    """Quorum measures HEADCOUNT agreement — never a weighted/pooled
+    tally: pooled probability mass is near-one-hot whenever sequence
+    logprobs differ by a few nats, and a single heavy panel member
+    must not end a debate unilaterally while most candidates/models
+    still disagree."""
+    heads = majority_vote(answers, key_fn)
+    lead = max(heads.tally.values()) / max(sum(heads.tally.values()), 1e-9)
+    return lead >= quorum
+
+
+def _revise_prompts(
+    revise_t: str,
+    question: str,
+    answers: list[str],
+    base: int,
+    n: int,
+    peer_sample: int,
+) -> list[str]:
+    """Build n revision prompts for candidates [base, base+n) over the
+    pooled ``answers`` (base=0, n=len(answers) for single-engine
+    debate; per-member blocks for panel debate)."""
+    return [
+        revise_t.format(
+            i=base + i,
+            q=question,
+            own=answers[base + i],
+            peers=_peer_digest(answers, base + i, peer_sample),
+        )
+        for i in range(n)
+    ]
+
+
 def _checked_templates(
     cfg: DebateConfig, question: str
 ) -> tuple[str, str]:
@@ -138,6 +171,8 @@ def run_debate(
             "method='rescore' needs an engine with score_texts "
             "(sharded engines included: completions shard over data)"
         )
+    if cfg.max_rounds < 1:
+        raise ValueError(f"max_rounds must be >= 1, got {cfg.max_rounds}")
     n = cfg.n_candidates
     rounds: list[DebateRound] = []
     total_tokens = 0
@@ -165,26 +200,12 @@ def run_debate(
                 engine, initial_t.format(q=question), answers, key_fn
             )
         rounds.append(DebateRound(answers=answers, vote=vote))
-        # The quorum early-exit always measures HEADCOUNT agreement:
-        # pooled probability mass (logit_pool/rescore) is near-one-hot
-        # whenever sequence logprobs differ by a few nats, which would
-        # end every debate after round 1 regardless of actual consensus.
-        heads = (
-            vote if cfg.method == "majority" else majority_vote(answers, key_fn)
-        )
-        lead = max(heads.tally.values()) / max(sum(heads.tally.values()), 1e-9)
-        if lead >= cfg.quorum:
+        if _quorum_reached(answers, key_fn, cfg.quorum):
             break
         if r + 1 < cfg.max_rounds:
-            prompts = [
-                revise_t.format(
-                    i=i,
-                    q=question,
-                    own=answers[i],
-                    peers=_peer_digest(answers, i, cfg.peer_sample),
-                )
-                for i in range(n)
-            ]
+            prompts = _revise_prompts(
+                revise_t, question, answers, 0, n, cfg.peer_sample
+            )
 
     final = rounds[-1].vote
     return DebateResult(
@@ -234,6 +255,8 @@ def run_panel_debate(
     ordered = sorted(engines.items())
     if not ordered:
         raise ValueError("panel debate needs at least one engine")
+    if cfg.max_rounds < 1:
+        raise ValueError(f"max_rounds must be >= 1, got {cfg.max_rounds}")
     n = cfg.n_candidates
     initial_t, revise_t = _checked_templates(cfg, question)
 
@@ -258,30 +281,13 @@ def run_panel_debate(
             total_tokens += sum(x.num_tokens for x in res)
         vote = weighted_vote(answers, weights, key_fn)
         rounds.append(DebateRound(answers=answers, vote=vote))
-        # Quorum measures HEADCOUNT agreement, not the weighted tally —
-        # the same invariant run_debate documents: a single heavy
-        # member must not end the debate unilaterally while most
-        # models still disagree (the cross-model exchange is the point).
-        heads = majority_vote(answers, key_fn)
-        lead = max(heads.tally.values()) / max(
-            sum(heads.tally.values()), 1e-9
-        )
-        if lead >= cfg.quorum:
+        if _quorum_reached(answers, key_fn, cfg.quorum):
             break
         if r + 1 < cfg.max_rounds:
             for bi, (name, _) in enumerate(ordered):
-                base = bi * n
-                member_prompts[name] = [
-                    revise_t.format(
-                        i=base + i,
-                        q=question,
-                        own=answers[base + i],
-                        peers=_peer_digest(
-                            answers, base + i, cfg.peer_sample
-                        ),
-                    )
-                    for i in range(n)
-                ]
+                member_prompts[name] = _revise_prompts(
+                    revise_t, question, answers, bi * n, n, cfg.peer_sample
+                )
 
     final = rounds[-1].vote
     return DebateResult(

@@ -126,6 +126,12 @@ def test_panel_debate_quorum_early_exit_and_method_guard():
         )
     with pytest.raises(ValueError, match="at least one"):
         run_panel_debate({}, "Q", DebateConfig())
+    with pytest.raises(ValueError, match="max_rounds"):
+        run_panel_debate(
+            {"a": (a, 1.0)}, "Q", DebateConfig(max_rounds=0)
+        )
+    with pytest.raises(ValueError, match="max_rounds"):
+        run_debate(FakeEngine([]), "Q", DebateConfig(max_rounds=0))
 
 
 def test_debate_on_real_tiny_engine():
