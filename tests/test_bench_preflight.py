@@ -6,16 +6,17 @@ loop's budget/retry contract with stubbed probe bodies so the logic
 stays testable offline.
 """
 
-import time
-
 import bench
 
 
 def test_await_chip_success_first_probe(monkeypatch):
     monkeypatch.setattr(bench, "_PROBE_SRC", "pass")
-    t0 = time.perf_counter()
-    assert bench._await_chip(budget_s=30, probe_timeout_s=10) is True
-    assert time.perf_counter() - t0 < 10  # no retry sleep on success
+    # Record (rather than time) the retry sleeps: wall-clock bounds are
+    # flaky under xdist contention on the 1-core CI host.
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    assert bench._await_chip(budget_s=600, probe_timeout_s=60) is True
+    assert sleeps == []  # success on the first probe, no retry sleep
 
 
 def test_await_chip_budget_expires_on_failing_probe(monkeypatch):
