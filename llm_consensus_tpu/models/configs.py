@@ -249,6 +249,21 @@ PRESETS: dict[str, ModelConfig] = {
         d_ff=2048,
         max_seq_len=768,
     ),
+    # ~5.4M model with arith2's 768 context: the draft-scale sibling of
+    # arith-25m (speculative decoding on the multi-step task needs a
+    # draft whose context fits the ~650-byte prompts+CoT — arith-3m's
+    # is too short). Measured 22 s/step on the 1-core host: NOT a CPU
+    # training fallback; train it on chip (~1-2 min).
+    "arith-6m": ModelConfig(
+        name="arith-6m",
+        vocab_size=384,
+        d_model=256,
+        n_layers=5,
+        n_heads=4,
+        n_kv_heads=4,
+        d_ff=1024,
+        max_seq_len=768,
+    ),
     # ~0.94B-total-param MoE sized to run on ONE chip (VERDICT r4 item
     # 5: no MoE had ever touched real silicon — Mixtral-8x7B needs an
     # expert>=4 mesh, PERF.md). 4 experts top-2, Mixtral-style routing
