@@ -15,6 +15,11 @@ Usage:
     python examples/spec_arith_demo.py \
         --target-ckpt runs/arith14m --draft-ckpt runs/arith3m \
         [--train-draft]  # trains the draft first if needed
+
+    # Early-snapshot-as-draft: the SAME preset at an earlier training
+    # step drafts for the converged target (no separate draft model):
+    python examples/spec_arith_demo.py --draft-model arith-14m \
+        --target-ckpt runs/arith14m --draft-ckpt runs/arith14m_mid2
 """
 
 from __future__ import annotations
@@ -51,6 +56,14 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--target-ckpt", default="runs/arith14m")
     p.add_argument("--draft-ckpt", default="runs/arith3m")
+    p.add_argument("--target-model", default="arith-14m")
+    p.add_argument(
+        "--draft-model",
+        default="arith-3m",
+        help="draft preset; pass the TARGET's preset with an earlier "
+        "training snapshot as --draft-ckpt to measure the "
+        "early-checkpoint-as-draft configuration",
+    )
     p.add_argument("--train-draft", action="store_true")
     p.add_argument("--draft-steps", type=int, default=6000)
     p.add_argument("--n-prompts", type=int, default=16)
@@ -83,7 +96,7 @@ def main() -> int:
         cmd = [
             sys.executable,
             str(Path(__file__).parent / "train_arith_em.py"),
-            "--model", "arith-3m",
+            "--model", args.draft_model,
             "--steps", str(args.draft_steps),
             "--ckpt-dir", args.draft_ckpt,
             "--train-only",
@@ -91,8 +104,8 @@ def main() -> int:
         print("[spec-demo] training draft:", " ".join(cmd), file=sys.stderr)
         subprocess.run(cmd, check=True)
 
-    t_cfg, t_params = _load_params("arith-14m", args.target_ckpt)
-    d_cfg, d_params = _load_params("arith-3m", args.draft_ckpt)
+    t_cfg, t_params = _load_params(args.target_model, args.target_ckpt)
+    d_cfg, d_params = _load_params(args.draft_model, args.draft_ckpt)
     tok = ByteTokenizer()
 
     if args.n_prompts > args.holdout_n:
@@ -179,6 +192,10 @@ def main() -> int:
     result = {
         "target": t_cfg.name,
         "draft": d_cfg.name,
+        # Checkpoint dirs disambiguate same-preset configurations (the
+        # early-snapshot-as-draft mode has target.name == draft.name).
+        "target_ckpt": args.target_ckpt,
+        "draft_ckpt": args.draft_ckpt,
         "n_prompts": b,
         "k_spec": args.k_spec,
         "acceptance": round(acc, 4),

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the tunnel chip every 5 min; log status. (Round-4 pattern: the
+# chip can go unresponsive for hours; queue legs block until it heals.)
+cd /root/repo || exit 1
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 90 python -c "
+import jax, numpy as np, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.full((8,8), 2.0)
+v = float(np.asarray(x @ x)[0,0])
+print(f'ok {d.platform} {v}')
+" 2>/dev/null | tail -1)
+  echo "$ts ${out:-TIMEOUT(90s)}" >> runs/chip_watchdog.log
+  sleep 300
+done
