@@ -35,7 +35,19 @@ leg() {  # leg <artifact> <cmd...>
 
 date -u
 
-# Q1: arith-14m on-chip EM at the full N set (checkpoints exist).
+# Q0: (fresh-container case) re-train the arith-14m maturities if the
+# checkpoints were wiped with runs/. ~210-275 s each on chip.
+for spec in "runs/arith14m_mid 1500" "runs/arith14m_mid2 2500" \
+            "runs/arith14m 6000"; do
+  set -- $spec
+  if [ ! -e "$1/DONE" ] && [ ! -d "$1/LATEST" ]; then
+    wait_chip
+    python examples/train_arith_em.py --steps "$2" --ckpt-dir "$1" \
+      --train-only && touch "$1/DONE"
+  fi
+done
+
+# Q1: arith-14m on-chip EM at the full N set.
 leg runs/reports/arith14m_em_r5.json \
   python examples/train_arith_em.py --eval-only --ckpt-dir runs/arith14m \
     --ns 1 4 8 32 64 --report runs/reports/arith14m_em_r5.json
