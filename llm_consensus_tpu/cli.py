@@ -148,11 +148,10 @@ def _build_backend(args):
     return LocalBackend(engine)
 
 
-def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
-        prog="llm_consensus_tpu",
-        description="Multi-persona LLM consensus on local TPU inference.",
-    )
+def _add_backend_args(p: argparse.ArgumentParser) -> None:
+    """Backend-construction flags — the ONE definition of everything
+    `_build_backend` reads, shared by the main parser and `serve` so the
+    two cannot drift apart."""
     p.add_argument("--backend", choices=["fake", "local"], default="fake")
     p.add_argument(
         "--cpu",
@@ -194,6 +193,10 @@ def build_parser() -> argparse.ArgumentParser:
         "'data=4,model=2' (axes: data/model/expert/seq/pipe; product "
         "must equal the device count; seq>1 enables ring attention)",
     )
+
+
+def _add_protocol_args(p: argparse.ArgumentParser) -> None:
+    """Panel-protocol defaults shared by the REPL and `serve`."""
     p.add_argument("--panel", default=None, help="panel JSON file")
     p.add_argument(
         "--max-rounds",
@@ -205,6 +208,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=256)
     p.add_argument("--temperature", type=float, default=0.7)
     p.add_argument("--seed", type=int, default=None)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="llm_consensus_tpu",
+        description="Multi-persona LLM consensus on local TPU inference.",
+    )
+    _add_backend_args(p)
+    _add_protocol_args(p)
     p.add_argument(
         "--question", default=None, help="answer one question and exit"
     )
@@ -340,8 +352,105 @@ async def repl(coord: Coordinator, stream=None) -> None:
         coord.reset()
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    """Parser for the ``serve`` subcommand (the serving gateway).
+
+    Shares the backend-construction flags with the main parser so
+    ``serve`` can front any substrate the REPL can (fake for tests,
+    local engines incl. mesh/quant/draft for real serving).
+    """
+    p = argparse.ArgumentParser(
+        prog="llm_consensus_tpu serve",
+        description="HTTP serving gateway: /v1/generate, /v1/consensus, "
+        "/metrics, /healthz (SIGTERM drains gracefully).",
+    )
+    _add_backend_args(p)
+    _add_protocol_args(p)
+    # Gateway flags.
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port",
+        type=int,
+        default=8080,
+        help="TCP port (0 = ephemeral; the bound port is logged)",
+    )
+    p.add_argument(
+        "--queue-bound",
+        type=int,
+        default=64,
+        help="per-priority admission queue bound (full => 429 + "
+        "Retry-After)",
+    )
+    p.add_argument(
+        "--max-inflight",
+        type=int,
+        default=8,
+        help="concurrent in-flight executions across priorities",
+    )
+    p.add_argument(
+        "--default-deadline-s",
+        type=float,
+        default=None,
+        help="deadline applied to requests that do not carry one",
+    )
+    return p
+
+
+def _run_serve(argv: list[str]) -> int:
+    """The ``serve`` subcommand: build backend + panel, run the gateway
+    until SIGTERM/SIGINT, then drain (stop admitting, finish in-flight)."""
+    import signal
+
+    from llm_consensus_tpu.server.admission import AdmissionConfig
+    from llm_consensus_tpu.server.gateway import Gateway, GatewayConfig
+
+    args = build_serve_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    panel = load_panel(args.panel) if args.panel else default_panel()
+    backend = _build_backend(args)
+    gateway = Gateway(
+        backend,
+        panel=panel,
+        config=GatewayConfig(
+            host=args.host,
+            port=args.port,
+            admission=AdmissionConfig(
+                max_queue=args.queue_bound,
+                max_inflight=args.max_inflight,
+                default_deadline_s=args.default_deadline_s,
+            ),
+            sampling=SamplingParams(
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+            ),
+            max_rounds=args.max_rounds,
+            consensus_seed=args.seed,
+        ),
+    )
+
+    async def _serve() -> None:
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-Unix event loops
+                pass
+        await gateway.run_until(stop)
+
+    asyncio.run(_serve())
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     _init_logging()
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv[:1] == ["serve"]:
+        return _run_serve(argv[1:])
     args = build_parser().parse_args(argv)
 
     if args.cpu:

@@ -60,8 +60,25 @@ from llm_consensus_tpu.consensus.prompts import (
     evaluation_prompt,
     refinement_prompt,
 )
+from llm_consensus_tpu.server.metrics import REGISTRY as _REG
 
 log = logging.getLogger(__name__)
+
+# Process-wide consensus metrics (exported at the gateway's /metrics).
+_M_QUESTIONS = _REG.counter(
+    "consensus_questions_total", "Questions driven through the protocol"
+)
+_M_ROUNDS = _REG.histogram(
+    "consensus_rounds",
+    "Evaluation rounds to termination (unanimity or the round cap)",
+    buckets=(1, 2, 3, 4, 5, 6, 8, 10, 15, 20),
+)
+_M_UNANIMOUS = _REG.counter(
+    "consensus_unanimous_total", "Questions ending in genuine unanimity"
+)
+_M_FORCED = _REG.counter(
+    "consensus_forced_total", "Questions force-terminated at the round cap"
+)
 
 
 @dataclass(frozen=True)
@@ -369,6 +386,9 @@ class Coordinator:
             feedback=dict(self.feedback),
             transcript=list(self.transcript),
         )
+        _M_QUESTIONS.inc()
+        _M_ROUNDS.observe(final.rounds)
+        (_M_UNANIMOUS if final.endorsed else _M_FORCED).inc()
         log.info("Final answer: %s", final.answer)
         return final
 

@@ -511,6 +511,24 @@ class InferenceEngine:
         # Identical suffixes (self-consistency fan-out under a cached
         # header): chunk the suffix once at B=1 and broadcast.
         shared = n_real == b and len(set(prompts)) == 1 and b > 1
+        # MoE dispatch-path alignment: resolve dense-vs-capacity for the
+        # suffix chunk from the count the plain CONCATENATED path would
+        # trace — batch x seq-bucket of the true concat length (B=1 when
+        # its shared prefill collapses the batch, mirrored by `shared`
+        # here). The prefix KV bucket width pb plays no part: it can
+        # overshoot moe_dense_decode_tokens for a prompt whose concat
+        # bucket sits under it (the round-5 divergence). Rides as a
+        # static BOOL so the compiled-program count stays bounded by the
+        # buckets. Only capacity-routed MoE configs pass it; everything
+        # else keeps the jit key untouched with None. See
+        # _prefix_prefill_impl.
+        moe_dense = None
+        if self.cfg.is_moe and self.cfg.moe_capacity_factor > 0:
+            s_plain = min(
+                _next_bucket(p + longest, self.config.seq_buckets),
+                self.cfg.max_seq_len,
+            )
+            moe_dense = self.cfg.moe_dense_at((1 if shared else b) * s_plain)
         tokens_j = jnp.asarray(tokens)
         lengths_j = jnp.asarray(lengths)
         temps_j = jnp.asarray(temps)
@@ -546,6 +564,7 @@ class InferenceEngine:
                     cache_len=pb + s + mnt,
                     shared_suffix=shared,
                     kv_quant=self.config.kv_quant,
+                    moe_suffix_dense=moe_dense,
                 )
                 return self._chunked_stop_decode(
                     logits, cache, temps_j, n_real, seed, mnt, sampler,
@@ -575,6 +594,7 @@ class InferenceEngine:
                 stop_ids=self._stop_ids(stop),
                 shared_suffix=shared,
                 kv_quant=self.config.kv_quant,
+                moe_suffix_dense=moe_dense,
             )
         return self._trim_stops(self._collect(out, n_real), stop)
 

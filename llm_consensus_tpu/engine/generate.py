@@ -298,6 +298,7 @@ def _decode_loop(
         "stop_ids",
         "shared_suffix",
         "kv_quant",
+        "moe_suffix_dense",
     ),
 )
 def generate_from_prefix(
@@ -319,6 +320,7 @@ def generate_from_prefix(
     stop_ids: tuple[int, ...] = (),
     shared_suffix: bool = False,
     kv_quant: bool = False,
+    moe_suffix_dense: bool | None = None,
 ) -> GenerateOutput:
     """Generate continuing from a prefilled shared prompt prefix.
 
@@ -360,6 +362,17 @@ def generate_from_prefix(
     bf16 prefix K/V is quantized on entry with the SAME per-(token,
     head) rule prefill itself uses, so the cache holds identical int8
     values to a from-scratch quant prefill of the prefix.
+
+    ``moe_suffix_dense`` (static): the MoE dispatch-path choice for the
+    suffix chunk (dense fallback when True, capacity when False),
+    resolved by the caller from the count a plain one-shot prefill of
+    the CONCATENATED prompt traces (batch x seq-bucket of the true
+    concat length) — the bucketed ``prefix_k`` width can overshoot the
+    threshold that count sits under. A BOOLEAN rather than the raw
+    length so the jit cache stays bucket-bounded (a length int would
+    compile one program per distinct header size). ``None`` falls back
+    to deciding from the bucket width; engines pass it only for
+    capacity-routed MoE configs.
     """
     b, s = tokens.shape
     p = prefix_k.shape[2]  # bucket width Pb >= real prefix_len
@@ -376,6 +389,7 @@ def generate_from_prefix(
         cache_len=cache_len,
         shared_suffix=shared_suffix,
         kv_quant=kv_quant,
+        moe_suffix_dense=moe_suffix_dense,
     )
 
     return _decode_loop(
@@ -408,6 +422,7 @@ def _prefix_prefill_impl(
     cache_len: int,
     shared_suffix: bool = False,
     kv_quant: bool = False,
+    moe_suffix_dense: bool | None = None,
 ):
     """Steps 1-2 of :func:`generate_from_prefix` (copy prefix K/V into a
     fresh cache, run the suffix chunk): returns (first-token logits
@@ -426,16 +441,25 @@ def _prefix_prefill_impl(
     # one-shot prefill of the CONCATENATED prompt would trace at this
     # batch: generate_from_prefix is exactness-tested against
     # generate(), and the prefix+suffix split must not flip the suffix
-    # onto the other side of the trace-time dense fallback. Two
-    # approximations, both near the threshold only: the true prefix
-    # length is traced data, so the comparison uses the pow2 BUCKET
-    # width prefix_k.shape[2] (>= real length — near-threshold prompts
-    # can pin capacity where plain ran dense), and on the capacity side
-    # per-program capacity still drops differently than one-shot
-    # (ModelConfig.moe_pin_for). Away from the threshold and at
-    # generous capacity factors the contract is bitwise.
+    # onto the other side of the trace-time dense fallback. The engine
+    # resolves the choice from the count plain itself traces (batch x
+    # seq-bucket of the true concat length) and passes it as
+    # ``moe_suffix_dense``: the pow2 BUCKET width prefix_k.shape[2] can
+    # overshoot ``moe_dense_decode_tokens`` for a prompt whose concat
+    # bucket sits under it, pinning capacity where plain ran dense —
+    # a real numeric divergence whenever capacity binds (tested in
+    # test_engine.py::test_engine_prefix_moe_straddles_dense_threshold).
+    # Remaining approximation, near the threshold only: on the capacity
+    # side, per-program capacity still drops differently than one-shot
+    # (ModelConfig.moe_pin_for). At generous capacity factors the
+    # contract is bitwise.
     total = cb * (prefix_k.shape[2] + s)
-    cfg = cfg.moe_pin_for(total, total)
+    if moe_suffix_dense is None:
+        cfg = cfg.moe_pin_for(total, total)  # bucket-width fallback
+    elif moe_suffix_dense:
+        cfg = cfg.with_moe_dense_up_to(total)
+    else:
+        cfg = cfg.with_moe_capacity_pinned()
     plen = jnp.asarray(prefix_len, jnp.int32)
     if kv_quant:
         qcache = QuantKVCache.create(cfg, cb, cache_len)
@@ -491,7 +515,9 @@ def _prefix_prefill_impl(
 
 prefill_from_prefix = partial(
     jax.jit,
-    static_argnames=("cfg", "cache_len", "shared_suffix", "kv_quant"),
+    static_argnames=(
+        "cfg", "cache_len", "shared_suffix", "kv_quant", "moe_suffix_dense",
+    ),
 )(_prefix_prefill_impl)
 
 

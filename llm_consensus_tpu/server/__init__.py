@@ -1,0 +1,57 @@
+"""Serving gateway: the network-facing layer over the scheduler /
+continuous-batcher / coordinator stack.
+
+The reference's serving story is the anti-pattern this package replaces:
+unbounded per-request HTTP futures with no admission control and no
+observability (``src/main.rs:101,156,182``). Here the entry point is a
+hand-rolled asyncio HTTP/1.1 gateway (stdlib only — no new deps) with:
+
+- :mod:`llm_consensus_tpu.server.gateway` — ``POST /v1/generate`` (with
+  SSE token streaming), ``POST /v1/consensus`` (the full panel
+  protocol), ``GET /metrics``, ``GET /healthz``;
+- :mod:`llm_consensus_tpu.server.admission` — bounded per-priority
+  queues with load shedding (429 + Retry-After), per-request deadlines,
+  graceful drain on SIGTERM;
+- :mod:`llm_consensus_tpu.server.metrics` — a process-wide registry of
+  counters/gauges/histograms exported in Prometheus text format;
+- :mod:`llm_consensus_tpu.server.client` — a stdlib client speaking the
+  gateway's wire protocol (incl. SSE parsing).
+
+Every later scale-out layer (multi-replica routing, disaggregated
+prefill/decode serving) plugs in behind this gateway.
+
+Submodules import lazily: ``server.metrics`` is imported from the hot
+serving/consensus modules for instrumentation, and an eager gateway
+import here would cycle back through them.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "Gateway",
+    "GatewayClient",
+    "GatewayConfig",
+    "MetricsRegistry",
+    "REGISTRY",
+]
+
+_EXPORTS = {
+    "AdmissionConfig": "llm_consensus_tpu.server.admission",
+    "AdmissionController": "llm_consensus_tpu.server.admission",
+    "Gateway": "llm_consensus_tpu.server.gateway",
+    "GatewayConfig": "llm_consensus_tpu.server.gateway",
+    "GatewayClient": "llm_consensus_tpu.server.client",
+    "MetricsRegistry": "llm_consensus_tpu.server.metrics",
+    "REGISTRY": "llm_consensus_tpu.server.metrics",
+}
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

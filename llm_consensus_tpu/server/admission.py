@@ -1,0 +1,339 @@
+"""Admission control: bounded per-priority queues, shedding, deadlines, drain.
+
+The reference accepts unbounded concurrent work — every HTTP request
+spawns a future immediately (``src/main.rs:101,156,182``), so overload
+manifests as memory growth and collapse instead of backpressure. This
+module is the opposite contract, the one every production serving stack
+makes explicit:
+
+- **Bounded queues, one per priority.** When a priority's queue is full
+  the request is SHED at the door (:class:`QueueFullError` -> the
+  gateway's ``429`` + ``Retry-After``) instead of admitted into an
+  ever-deeper backlog. Dispatch drains strictly by priority order.
+- **Deadlines.** A request may carry a deadline; if it expires while
+  still queued the work is cancelled before it ever touches the backend
+  (:class:`DeadlineExpiredError` -> ``504``), and an admitted request's
+  backend call runs under ``asyncio.wait_for`` with the remaining
+  budget so in-flight work is cancelled at the deadline too.
+- **Graceful drain.** :meth:`AdmissionController.drain` stops admitting
+  (:class:`DrainingError` -> ``503``) and waits for every
+  already-admitted request — queued and in-flight — to reach its
+  terminal outcome. The gateway calls it on SIGTERM.
+
+Single-event-loop asyncio; the controller owns a dispatcher task with a
+bounded in-flight window (``max_inflight``) so the backend sees at most
+a fixed number of concurrent batch calls regardless of queue depth.
+
+Every transition feeds the metrics registry: queue depth gauges,
+admitted/shed/expired/completed counters (all labeled by priority), and
+queue-wait histograms — the series the overload integration test
+cross-checks against observed HTTP outcomes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from collections.abc import Awaitable, Callable
+from dataclasses import dataclass, field
+
+from llm_consensus_tpu.server import metrics as _metrics
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "DeadlineExpiredError",
+    "DrainingError",
+    "QueueFullError",
+]
+
+
+class QueueFullError(Exception):
+    """Load shed: the request's priority queue is at its bound."""
+
+    def __init__(self, priority: str, retry_after: float):
+        super().__init__(
+            f"{priority} queue full; retry after {retry_after:.1f}s"
+        )
+        self.priority = priority
+        self.retry_after = retry_after
+
+
+class DrainingError(Exception):
+    """The controller is draining (SIGTERM): no new admissions."""
+
+
+class DeadlineExpiredError(Exception):
+    """The request's deadline passed before the work completed."""
+
+
+@dataclass
+class AdmissionConfig:
+    # Priority order = dispatch order: the first listed priority drains
+    # first. Every request names one of these.
+    priorities: tuple[str, ...] = ("interactive", "batch")
+    # Per-priority queue bound; an int applies to every priority, a dict
+    # overrides per name.
+    max_queue: int | dict[str, int] = 64
+    # Concurrent in-flight executions across all priorities. The backend
+    # underneath batches, so a handful of concurrent generate_batch
+    # calls keeps the chip full without unbounded task fan-out.
+    max_inflight: int = 8
+    # Deadline applied when a request does not carry one; None = none.
+    default_deadline_s: float | None = None
+    # Retry-After hint returned on shed when the queue-wait history is
+    # still empty.
+    retry_after_s: float = 1.0
+
+    def bound_for(self, priority: str) -> int:
+        if isinstance(self.max_queue, dict):
+            return int(self.max_queue.get(priority, 64))
+        return int(self.max_queue)
+
+
+@dataclass
+class _Item:
+    thunk: Callable[[], Awaitable]
+    priority: str
+    deadline: float | None  # monotonic seconds, None = no deadline
+    enqueued_at: float
+    future: asyncio.Future = field(default_factory=asyncio.Future)
+
+
+class AdmissionController:
+    """Bounded-queue dispatcher between the gateway and a backend."""
+
+    def __init__(
+        self,
+        config: AdmissionConfig | None = None,
+        registry: _metrics.MetricsRegistry | None = None,
+    ):
+        self.config = config or AdmissionConfig()
+        if not self.config.priorities:
+            raise ValueError("need at least one priority")
+        reg = registry or _metrics.REGISTRY
+        self._queues: dict[str, deque[_Item]] = {
+            p: deque() for p in self.config.priorities
+        }
+        self._inflight = 0
+        self._draining = False
+        self._work = asyncio.Event()
+        self._idle = asyncio.Event()
+        self._idle.set()
+        self._dispatcher: asyncio.Task | None = None
+        self._m_depth = reg.gauge(
+            "gateway_queue_depth", "Requests waiting for admission"
+        )
+        self._m_inflight = reg.gauge(
+            "gateway_inflight", "Requests currently executing"
+        )
+        self._m_admitted = reg.counter(
+            "gateway_admitted_total", "Requests accepted into a queue"
+        )
+        self._m_shed = reg.counter(
+            "gateway_shed_total", "Requests shed with 429 (queue full)"
+        )
+        self._m_expired = reg.counter(
+            "gateway_deadline_expired_total",
+            "Requests that hit their deadline before completing",
+        )
+        self._m_completed = reg.counter(
+            "gateway_completed_total",
+            "Admitted requests that reached a terminal outcome",
+        )
+        self._m_wait = reg.histogram(
+            "gateway_queue_wait_seconds",
+            "Time from admission to dispatch",
+        )
+
+    # -- admission ------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def pending(self) -> int:
+        """Admitted-but-unfinished request count (queued + in-flight)."""
+        return sum(len(q) for q in self._queues.values()) + self._inflight
+
+    async def submit(
+        self,
+        thunk: Callable[[], Awaitable],
+        *,
+        priority: str | None = None,
+        deadline_s: float | None = None,
+    ):
+        """Admit ``thunk`` and await its terminal outcome.
+
+        Raises :class:`DrainingError` / :class:`QueueFullError` at the
+        door, :class:`DeadlineExpiredError` when the deadline passes
+        (queued or in-flight), else returns/raises whatever the awaited
+        thunk does.
+        """
+        prio = priority or self.config.priorities[0]
+        q = self._queues.get(prio)
+        if q is None:
+            raise ValueError(
+                f"unknown priority {prio!r}; have {self.config.priorities}"
+            )
+        if self._draining:
+            raise DrainingError("gateway is draining; not admitting")
+        if len(q) >= self.config.bound_for(prio):
+            self._m_shed.labels(priority=prio).inc()
+            raise QueueFullError(prio, self._retry_after_hint())
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        now = time.monotonic()
+        item = _Item(
+            thunk=thunk,
+            priority=prio,
+            deadline=(now + deadline_s) if deadline_s is not None else None,
+            enqueued_at=now,
+        )
+        q.append(item)
+        self._m_admitted.labels(priority=prio).inc()
+        self._m_depth.labels(priority=prio).set(len(q))
+        self._idle.clear()
+        self._ensure_dispatcher()
+        self._work.set()
+        if item.deadline is not None:
+            # Wake the dispatcher at the deadline so a queued item is
+            # cancelled on time, not on the next unrelated admission.
+            asyncio.get_running_loop().call_later(
+                deadline_s, self._work.set
+            )
+        return await item.future
+
+    def _retry_after_hint(self) -> float:
+        """Shed hint: recent mean queue wait, else the configured floor."""
+        h = self._m_wait
+        if h.count:
+            return max(self.config.retry_after_s, h.sum / h.count)
+        return self.config.retry_after_s
+
+    # -- dispatch -------------------------------------------------------
+
+    def _ensure_dispatcher(self) -> None:
+        if self._dispatcher is None or self._dispatcher.done():
+            self._dispatcher = asyncio.create_task(
+                self._dispatch_loop(), name="admission-dispatcher"
+            )
+
+    def _next_item(self) -> _Item | None:
+        """Pop the next runnable item in strict priority order, resolving
+        any already-expired queued items along the way."""
+        now = time.monotonic()
+        for prio in self.config.priorities:
+            q = self._queues[prio]
+            while q:
+                item = q.popleft()
+                self._m_depth.labels(priority=prio).set(len(q))
+                if item.future.done():
+                    # Caller gave up while queued (e.g. an aborted SSE
+                    # client cancelled its submit): terminal already —
+                    # don't burn backend time on a dead request.
+                    self._m_completed.labels(priority=item.priority).inc()
+                    self._maybe_idle()
+                    continue
+                if item.deadline is not None and item.deadline <= now:
+                    self._expire(item)
+                    continue
+                return item
+        return None
+
+    def _expire(self, item: _Item) -> None:
+        self._m_expired.labels(priority=item.priority).inc()
+        self._m_completed.labels(priority=item.priority).inc()
+        if not item.future.done():
+            item.future.set_exception(
+                DeadlineExpiredError(
+                    f"deadline expired after "
+                    f"{time.monotonic() - item.enqueued_at:.3f}s in queue"
+                )
+            )
+        self._maybe_idle()
+
+    def _expire_due(self) -> None:
+        """Resolve every queued item whose deadline has passed. Runs on
+        each dispatcher wake-up even when the in-flight window is full —
+        a queued 504 must not wait for an unrelated slot to free."""
+        now = time.monotonic()
+        for prio in self.config.priorities:
+            q = self._queues[prio]
+            for _ in range(len(q)):
+                item = q.popleft()
+                if item.deadline is not None and item.deadline <= now:
+                    self._expire(item)
+                else:
+                    q.append(item)
+            self._m_depth.labels(priority=prio).set(len(q))
+
+    async def _dispatch_loop(self) -> None:
+        while True:
+            if self._inflight >= self.config.max_inflight:
+                self._expire_due()
+                await self._work.wait()
+                self._work.clear()
+                continue
+            item = self._next_item()
+            if item is None:
+                self._maybe_idle()
+                await self._work.wait()
+                self._work.clear()
+                continue
+            self._m_wait.observe(time.monotonic() - item.enqueued_at)
+            self._inflight += 1
+            self._m_inflight.set(self._inflight)
+            asyncio.create_task(self._run(item))
+
+    async def _run(self, item: _Item) -> None:
+        try:
+            coro = item.thunk()
+            if item.deadline is not None:
+                remaining = item.deadline - time.monotonic()
+                result = await asyncio.wait_for(coro, max(remaining, 0.0))
+            else:
+                result = await coro
+        except (asyncio.TimeoutError, TimeoutError):
+            self._m_expired.labels(priority=item.priority).inc()
+            if not item.future.done():
+                item.future.set_exception(
+                    DeadlineExpiredError("deadline expired mid-execution")
+                )
+        except Exception as e:  # noqa: BLE001 - forwarded to the caller
+            if not item.future.done():
+                item.future.set_exception(e)
+        else:
+            if not item.future.done():
+                item.future.set_result(result)
+        finally:
+            self._inflight -= 1
+            self._m_inflight.set(self._inflight)
+            self._m_completed.labels(priority=item.priority).inc()
+            self._maybe_idle()
+            self._work.set()
+
+    def _maybe_idle(self) -> None:
+        if self.pending() == 0:
+            self._idle.set()
+
+    # -- drain ----------------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admitting; already-admitted work keeps running."""
+        self._draining = True
+
+    async def drain(self) -> None:
+        """Stop admitting and wait until every admitted request (queued
+        and in-flight) has reached its terminal outcome."""
+        self.begin_drain()
+        self._work.set()
+        await self._idle.wait()
+        if self._dispatcher is not None:
+            self._dispatcher.cancel()
+            try:
+                await self._dispatcher
+            except asyncio.CancelledError:
+                pass
+            self._dispatcher = None

@@ -1,0 +1,142 @@
+"""Stdlib client for the serving gateway (incl. SSE stream parsing).
+
+A thin, dependency-free wire client: tests drive overload/deadline/drain
+scenarios through it, and operators get a one-import Python API mirroring
+the curl examples in README "Serving". Synchronous by design — each call
+opens one ``http.client`` connection (the gateway closes connections per
+response), so N client threads are N concurrent requests, which is
+exactly what the overload tests need to be able to count.
+"""
+
+from __future__ import annotations
+
+import json
+from collections.abc import Iterator
+from http.client import HTTPConnection
+
+__all__ = ["GatewayClient", "GatewayHTTPError"]
+
+
+class GatewayHTTPError(Exception):
+    """Non-2xx gateway response, carrying the mapped admission outcome."""
+
+    def __init__(self, status: int, body: str, retry_after: float | None):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+        #: Parsed ``Retry-After`` seconds on 429/503 sheds, else None.
+        self.retry_after = retry_after
+
+
+class GatewayClient:
+    """Client for one gateway endpoint (host, port)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    # -- plumbing -------------------------------------------------------
+
+    def _open(self, method: str, path: str, payload: dict | None = None):
+        """Open one connection and send the request; return
+        ``(conn, resp)`` with the response unread, raising
+        :class:`GatewayHTTPError` (and closing the connection) on any
+        non-200 — the ONE copy of the error prologue, shared by the
+        buffered and streaming paths. The caller owns ``conn.close()``
+        on success."""
+        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            body = json.dumps(payload).encode() if payload is not None else None
+            headers = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                data = resp.read()
+                ra = resp.getheader("Retry-After")
+                raise GatewayHTTPError(
+                    resp.status,
+                    data.decode(errors="replace"),
+                    float(ra) if ra is not None else None,
+                )
+        except BaseException:
+            conn.close()
+            raise
+        return conn, resp
+
+    def _request(self, method: str, path: str, payload: dict | None = None):
+        conn, resp = self._open(method, path, payload)
+        try:
+            return resp, resp.read()
+        finally:
+            conn.close()
+
+    def _json(self, method: str, path: str, payload: dict | None = None):
+        _, data = self._request(method, path, payload)
+        return json.loads(data)
+
+    # -- API ------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        return self._json("GET", "/healthz")
+
+    def metrics(self) -> str:
+        _, data = self._request("GET", "/metrics")
+        return data.decode()
+
+    def generate(self, prompt: str, **params) -> dict:
+        """``POST /v1/generate`` -> ``{"text", "num_tokens", "logprob"}``.
+
+        Keyword params pass through to the request body
+        (max_new_tokens, temperature, top_k, top_p, seed, stop,
+        priority, deadline_s, model).
+        """
+        return self._json(
+            "POST", "/v1/generate", {"prompt": prompt, **params}
+        )
+
+    def consensus(self, question: str, **params) -> dict:
+        """``POST /v1/consensus`` -> answer/rounds/endorsed/author/feedback."""
+        return self._json(
+            "POST", "/v1/consensus", {"question": question, **params}
+        )
+
+    def stream_generate(self, prompt: str, **params) -> Iterator[dict]:
+        """``POST /v1/generate`` with ``stream=true``: yields each SSE
+        event's JSON payload (``{"text": piece}`` chunks, then a final
+        ``{"done": true, ...}``). Terminates on ``[DONE]``."""
+        conn, resp = self._open(
+            "POST", "/v1/generate", {"prompt": prompt, "stream": True, **params}
+        )
+        try:
+            for payload in _iter_sse(resp):
+                if payload == "[DONE]":
+                    return
+                yield json.loads(payload)
+        finally:
+            conn.close()
+
+    def stream_text(self, prompt: str, **params) -> str:
+        """Convenience: concatenate a stream's text pieces."""
+        return "".join(
+            ev.get("text", "") for ev in self.stream_generate(prompt, **params)
+        )
+
+
+def _iter_sse(resp) -> Iterator[str]:
+    """Yield the data payload of each SSE event from a response stream."""
+    data_lines: list[str] = []
+    while True:
+        raw = resp.readline()
+        if not raw:  # EOF: connection closed by the server
+            if data_lines:
+                yield "\n".join(data_lines)
+            return
+        line = raw.decode().rstrip("\r\n")
+        if not line:  # blank line terminates one event
+            if data_lines:
+                yield "\n".join(data_lines)
+                data_lines = []
+            continue
+        if line.startswith("data:"):
+            data_lines.append(line[5:].lstrip())

@@ -1,0 +1,725 @@
+"""Asyncio HTTP/1.1 serving gateway (hand-rolled, stdlib only).
+
+The network-facing layer over the scheduler / continuous-batcher /
+coordinator stack. The reference binds actix HTTP handlers straight to
+the coordinator with unbounded per-request futures
+(``src/main.rs:101,156,182``); this gateway instead routes every request
+through :class:`~llm_consensus_tpu.server.admission.AdmissionController`
+(bounded queues, shed, deadlines, drain) and exports the metrics
+registry at a standard scrape endpoint.
+
+Routes:
+
+- ``POST /v1/generate`` — one completion from the backend. Body:
+  ``{"prompt": ..., "max_new_tokens"?, "temperature"?, "top_k"?,
+  "top_p"?, "seed"?, "stop"?, "stream"?, "priority"?, "deadline_s"?}``.
+  With ``"stream": true`` the response is Server-Sent Events: one
+  ``data: {"text": piece}`` event per token chunk, a final
+  ``data: {"done": true, ...}`` summary, then ``data: [DONE]``.
+- ``POST /v1/consensus`` — drives the FULL panel protocol
+  (:class:`~llm_consensus_tpu.consensus.coordinator.Coordinator`) for
+  ``{"question": ..., "max_rounds"?, "seed"?, "priority"?,
+  "deadline_s"?}`` and returns answer/rounds/endorsed/author/feedback.
+- ``GET /metrics`` — Prometheus text exposition of the registry.
+- ``GET /healthz`` — liveness + drain state.
+
+Status mapping: 429 + ``Retry-After`` on shed, 503 + ``Retry-After``
+while draining, 504 on deadline expiry, 502 on backend failure, 400 on
+malformed requests. Every response closes the connection
+(``Connection: close``) — serving concurrency comes from concurrent
+connections, which asyncio multiplexes on one loop.
+
+The HTTP layer is deliberately minimal (HTTP/1.1, Content-Length
+bodies, no TLS, no keep-alive, no chunked *request* bodies): it is the
+in-process front door for tests and single-host serving, and the
+protocol surface later scale-out PRs (multi-replica routing,
+disaggregated prefill) stand behind.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import math
+import re
+import threading
+import time
+
+from llm_consensus_tpu.backends.base import (
+    Backend,
+    BackendError,
+    GenerationRequest,
+    GenerationResult,
+    SamplingParams,
+)
+from llm_consensus_tpu.server import metrics as _metrics
+from llm_consensus_tpu.server.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    DeadlineExpiredError,
+    DrainingError,
+    QueueFullError,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Gateway", "GatewayConfig", "GatewayThread"]
+
+_MAX_HEADER_LINES = 100
+_TOKENISH = re.compile(r"\S+\s*|\s+")
+
+
+class _HTTPError(Exception):
+    def __init__(self, status: int, message: str, headers=None):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.headers = dict(headers or {})
+
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    408: "Request Timeout",
+    413: "Payload Too Large",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    502: "Bad Gateway",
+    503: "Service Unavailable",
+    504: "Gateway Timeout",
+}
+
+
+class GatewayConfig:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        admission: AdmissionConfig | None = None,
+        max_body_bytes: int = 1 << 20,
+        # Cap on reading one request's head+body. An idle open socket
+        # otherwise pins the handler (and with it drain: Server.
+        # wait_closed waits on every active connection) forever.
+        read_timeout_s: float = 30.0,
+        # Default sampling for /v1/generate when the body omits a field.
+        sampling: SamplingParams | None = None,
+        # Coordinator defaults for /v1/consensus.
+        max_rounds: int = 5,
+        consensus_seed: int | None = None,
+    ):
+        self.host = host
+        self.port = port
+        self.admission = admission or AdmissionConfig()
+        self.max_body_bytes = max_body_bytes
+        self.read_timeout_s = read_timeout_s
+        self.sampling = sampling or SamplingParams()
+        self.max_rounds = max_rounds
+        self.consensus_seed = consensus_seed
+
+
+class Gateway:
+    """One backend + one panel behind an admission-controlled HTTP front.
+
+    ``panel`` feeds ``POST /v1/consensus``; each request gets a fresh
+    :class:`Coordinator` (the coordinator holds per-question state, so
+    instances are per-request while panel/backend/config are shared).
+    """
+
+    def __init__(
+        self,
+        backend: Backend,
+        panel=None,
+        config: GatewayConfig | None = None,
+        registry: _metrics.MetricsRegistry | None = None,
+    ):
+        self.backend = backend
+        self.config = config or GatewayConfig()
+        self.registry = registry or _metrics.REGISTRY
+        if panel is None:
+            from llm_consensus_tpu.consensus.personas import default_panel
+
+            panel = default_panel()
+        self.panel = panel
+        self.admission = AdmissionController(
+            self.config.admission, registry=self.registry
+        )
+        self._server: asyncio.Server | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self.port: int | None = None  # actual bound port (ephemeral-safe)
+        self._started = time.monotonic()
+        reg = self.registry
+        self._m_requests = reg.counter(
+            "gateway_requests_total", "HTTP requests by route and status"
+        )
+        self._m_ttft = reg.histogram(
+            "gateway_ttft_seconds",
+            "Time from request arrival to first token byte",
+        )
+        self._m_latency = reg.histogram(
+            "gateway_request_seconds", "Full request latency"
+        )
+        self._m_tps = reg.histogram(
+            "gateway_tokens_per_second",
+            "Generated tokens per second of request wall-clock",
+            buckets=_metrics.THROUGHPUT_BUCKETS,
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.config.host, self.config.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info(
+            "gateway listening on %s:%d (%d panelists)",
+            self.config.host,
+            self.port,
+            len(self.panel),
+        )
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None, "call start() first"
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def drain(self) -> None:
+        """Graceful shutdown: stop admitting, finish every admitted
+        request, then stop accepting connections."""
+        log.info("gateway draining (%d pending)", self.admission.pending())
+        await self.admission.drain()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        # Server.wait_closed() does not wait for in-flight connection
+        # HANDLERS before 3.12 (gh-79033) — wait for them explicitly so
+        # an admitted request's response finishes writing before exit.
+        # Admitted work is already done and reads time out
+        # (read_timeout_s), so this is normally write-flush time only —
+        # but a client that stops READING its response can pin a write
+        # forever, so the wait carries the same bound.
+        if self._conn_tasks:
+            await asyncio.wait(
+                list(self._conn_tasks), timeout=self.config.read_timeout_s
+            )
+        log.info("gateway drained")
+
+    async def run_until(self, stop: asyncio.Event) -> None:
+        """Serve until ``stop`` is set, then drain. The serve CLI sets
+        ``stop`` from SIGTERM/SIGINT handlers."""
+        await self.start()
+        await stop.wait()
+        await self.drain()
+
+    # -- connection handling --------------------------------------------
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        # Tracked so drain() can wait for handlers (see drain()).
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        try:
+            try:
+                method, path, headers, body = await asyncio.wait_for(
+                    self._read_request(reader), self.config.read_timeout_s
+                )
+            except _HTTPError as e:
+                await self._respond_json(
+                    writer, e.status, {"error": e.message}, e.headers
+                )
+                return
+            except (asyncio.TimeoutError, TimeoutError):
+                with contextlib.suppress(Exception):
+                    await self._respond_json(
+                        writer, 408, {"error": "request read timed out"}
+                    )
+                return
+            except (ValueError, asyncio.LimitOverrunError):
+                # StreamReader raises ValueError for a request/header
+                # line past its 64 KiB limit: a client error, not a
+                # handler crash.
+                with contextlib.suppress(Exception):
+                    await self._respond_json(
+                        writer, 400, {"error": "malformed request"}
+                    )
+                return
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            await self._route(method, path, headers, body, writer)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:  # noqa: BLE001 - last-resort 500
+            log.exception("gateway handler crashed")
+            with contextlib.suppress(Exception):
+                await self._respond_json(
+                    writer, 500, {"error": "internal error"}
+                )
+        finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
+            with contextlib.suppress(Exception):
+                writer.close()
+                await writer.wait_closed()
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        line = await reader.readline()
+        if not line:
+            raise asyncio.IncompleteReadError(b"", None)
+        try:
+            method, path, _version = line.decode("latin-1").split()
+        except ValueError:
+            raise _HTTPError(400, "malformed request line") from None
+        headers: dict[str, str] = {}
+        for _ in range(_MAX_HEADER_LINES):
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, sep, v = h.decode("latin-1").partition(":")
+            if not sep:
+                raise _HTTPError(400, f"malformed header {h!r}")
+            headers[k.strip().lower()] = v.strip()
+        else:
+            raise _HTTPError(400, "too many headers")
+        body = b""
+        try:
+            n = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            raise _HTTPError(400, "malformed Content-Length") from None
+        if n < 0:
+            raise _HTTPError(400, "malformed Content-Length")
+        if n > self.config.max_body_bytes:
+            raise _HTTPError(413, f"body of {n} bytes exceeds limit")
+        if n:
+            body = await reader.readexactly(n)
+        return method, path.partition("?")[0], headers, body
+
+    async def _route(self, method, path, headers, body, writer) -> None:
+        if path == "/healthz" and method == "GET":
+            await self._respond_json(
+                writer,
+                200,
+                {
+                    "status": "draining" if self.admission.draining else "ok",
+                    "pending": self.admission.pending(),
+                    "uptime_s": round(time.monotonic() - self._started, 3),
+                },
+            )
+            self._count(path, 200)
+            return
+        if path == "/metrics" and method == "GET":
+            text = self.registry.render().encode()
+            await self._respond_raw(
+                writer, 200, text, "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self._count(path, 200)
+            return
+        if path in ("/v1/generate", "/v1/consensus"):
+            if method != "POST":
+                await self._respond_json(
+                    writer, 405, {"error": "POST only"}, {"Allow": "POST"}
+                )
+                self._count(path, 405)
+                return
+            try:
+                payload = json.loads(body or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+            except ValueError as e:
+                await self._respond_json(writer, 400, {"error": f"bad JSON: {e}"})
+                self._count(path, 400)
+                return
+            if path == "/v1/generate":
+                await self._handle_generate(payload, writer)
+            else:
+                await self._handle_consensus(payload, writer)
+            return
+        await self._respond_json(writer, 404, {"error": f"no route {path}"})
+        # Arbitrary client paths must not become metric labels (a port
+        # scan would grow the family without bound): one shared label.
+        self._count("<unmatched>", 404)
+
+    # -- routes ---------------------------------------------------------
+
+    def _sampling_from(self, payload: dict) -> SamplingParams:
+        d = self.config.sampling
+        stop = payload.get("stop") or ()
+        if isinstance(stop, str):
+            stop = (stop,)
+        return SamplingParams(
+            max_new_tokens=int(
+                payload.get("max_new_tokens", d.max_new_tokens)
+            ),
+            temperature=float(payload.get("temperature", d.temperature)),
+            top_k=int(payload.get("top_k", d.top_k)),
+            top_p=float(payload.get("top_p", d.top_p)),
+            seed=int(payload.get("seed", d.seed)),
+            stop=tuple(stop),
+        )
+
+    @staticmethod
+    def _admission_kw(payload: dict, default_priority: str) -> dict:
+        kw = {"priority": payload.get("priority", default_priority)}
+        if payload.get("deadline_s") is not None:
+            d = float(payload["deadline_s"])
+            # json.loads accepts NaN/Infinity: a non-finite deadline
+            # reaches loop.call_later(nan) and corrupts the shared timer
+            # heap (NaN compares False both ways) for the whole process.
+            if not math.isfinite(d):
+                raise ValueError(f"deadline_s must be finite, got {d}")
+            kw["deadline_s"] = d
+        return kw
+
+    async def _handle_generate(self, payload: dict, writer) -> None:
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            await self._respond_json(
+                writer, 400, {"error": "need a non-empty string 'prompt'"}
+            )
+            self._count("/v1/generate", 400)
+            return
+        # Field coercion up front: a mistyped body ("max_new_tokens":
+        # "abc") is the client's 400, not a handler crash.
+        try:
+            req = GenerationRequest(
+                prompt=prompt,
+                params=self._sampling_from(payload),
+                model=payload.get("model"),
+            )
+            adm_kw = self._admission_kw(payload, "interactive")
+        except (TypeError, ValueError, OverflowError) as e:
+            await self._respond_json(
+                writer, 400, {"error": f"bad request field: {e}"}
+            )
+            self._count("/v1/generate", 400)
+            return
+        t0 = time.monotonic()
+        if payload.get("stream"):
+            await self._handle_generate_stream(req, adm_kw, writer, t0)
+            return
+        try:
+            result: GenerationResult = await self.admission.submit(
+                lambda: self.backend.generate(req), **adm_kw
+            )
+        except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
+            status, doc, headers = self._error_response(e)
+            await self._respond_json(writer, status, doc, headers)
+            self._count("/v1/generate", status)
+            return
+        dt = time.monotonic() - t0
+        self._observe_generation(dt, dt, result.num_tokens)
+        await self._respond_json(
+            writer,
+            200,
+            {
+                "text": result.text,
+                "num_tokens": result.num_tokens,
+                "logprob": result.logprob,
+            },
+        )
+        self._count("/v1/generate", 200)
+
+    async def _handle_generate_stream(
+        self, req: GenerationRequest, adm_kw: dict, writer, t0: float
+    ) -> None:
+        """SSE streaming: events flow as the backend produces pieces.
+
+        Backends that expose token streaming (an async-generator
+        ``generate_stream(request)``) stream truly incrementally; any
+        other backend falls back to one admission-controlled generate
+        whose text is then chunked into token-ish SSE events — the
+        stream CONTENT is identical either way (tested).
+        """
+        q: asyncio.Queue[str] = asyncio.Queue()
+        loop = asyncio.get_running_loop()
+
+        def push(piece: str) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, piece)
+
+        task = asyncio.create_task(
+            self.admission.submit(
+                lambda: self._streaming_thunk(req, push), **adm_kw
+            )
+        )
+        first_at: float | None = None
+        headers_sent = False
+
+        async def emit(piece: str) -> None:
+            nonlocal first_at, headers_sent
+            if not headers_sent:
+                await self._start_sse(writer)
+                headers_sent = True
+            if first_at is None:
+                first_at = time.monotonic()
+                self._m_ttft.observe(first_at - t0)
+            await self._sse_event(writer, {"text": piece})
+
+        try:
+            while True:
+                getter = asyncio.create_task(q.get())
+                done, _pending = await asyncio.wait(
+                    {getter, task}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if getter in done:
+                    await emit(getter.result())
+                    continue
+                getter.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await getter
+                break
+            # Terminal: flush any pieces the producer pushed after the
+            # last wait round, then the summary.
+            while not q.empty():
+                await emit(q.get_nowait())
+            result: GenerationResult = task.result()
+        except ConnectionError:
+            # The client went away mid-stream (curl ^C, reset): routine,
+            # not a server error — stop awaiting the admission outcome
+            # (its bookkeeping retires the dispatched work either way)
+            # and count a client abort instead of a 500.
+            task.cancel()
+            with contextlib.suppress(BaseException):
+                await task
+            self._count("/v1/generate", 499)  # nginx-style client abort
+            return
+        except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
+            status, doc, headers = self._error_response(e)
+            if headers_sent:
+                # Mid-stream failure: the status line is gone; surface a
+                # terminal error event instead.
+                with contextlib.suppress(Exception):
+                    await self._sse_event(writer, {"error": doc["error"]})
+                    await self._sse_done(writer)
+            else:
+                await self._respond_json(writer, status, doc, headers)
+            self._count("/v1/generate", status)
+            return
+        dt = time.monotonic() - t0
+        if not headers_sent:  # empty completion: still a valid stream
+            await self._start_sse(writer)
+            headers_sent = True
+        if first_at is None:
+            self._m_ttft.observe(dt)
+        self._observe_generation(None, dt, result.num_tokens)
+        await self._sse_event(
+            writer, {"done": True, "num_tokens": result.num_tokens}
+        )
+        await self._sse_done(writer)
+        self._count("/v1/generate", 200)
+
+    async def _streaming_thunk(self, req: GenerationRequest, push):
+        """Produce pieces via ``push`` and return the final result."""
+        gs = getattr(self.backend, "generate_stream", None)
+        if gs is not None:
+            parts: list[str] = []
+            n = 0
+            async for piece in gs(req):
+                parts.append(piece)
+                n += 1
+                push(piece)
+            return GenerationResult(text="".join(parts), num_tokens=n)
+        result = await self.backend.generate(req)
+        for piece in _TOKENISH.findall(result.text):
+            push(piece)
+        return result
+
+    async def _handle_consensus(self, payload: dict, writer) -> None:
+        from llm_consensus_tpu.consensus.coordinator import (
+            Coordinator,
+            CoordinatorConfig,
+        )
+
+        question = payload.get("question")
+        if not isinstance(question, str) or not question:
+            await self._respond_json(
+                writer, 400, {"error": "need a non-empty string 'question'"}
+            )
+            self._count("/v1/consensus", 400)
+            return
+        try:
+            cfg = CoordinatorConfig(
+                max_rounds=int(
+                    payload.get("max_rounds", self.config.max_rounds)
+                ),
+                seed=payload.get("seed", self.config.consensus_seed),
+                sampling=self._sampling_from(payload),
+            )
+            adm_kw = self._admission_kw(payload, "batch")
+        except (TypeError, ValueError, OverflowError) as e:
+            await self._respond_json(
+                writer, 400, {"error": f"bad request field: {e}"}
+            )
+            self._count("/v1/consensus", 400)
+            return
+        t0 = time.monotonic()
+
+        def thunk():
+            # A fresh coordinator per request: the protocol state machine
+            # is per-question; panel/backend/config are the shared parts.
+            coord = Coordinator(list(self.panel), self.backend, cfg)
+            return coord.run(question)
+
+        try:
+            result = await self.admission.submit(thunk, **adm_kw)
+        except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
+            status, doc, headers = self._error_response(e)
+            await self._respond_json(writer, status, doc, headers)
+            self._count("/v1/consensus", status)
+            return
+        dt = time.monotonic() - t0
+        self._m_ttft.observe(dt)
+        self._m_latency.observe(dt)
+        await self._respond_json(
+            writer,
+            200,
+            {
+                "answer": result.answer,
+                "rounds": result.rounds,
+                "endorsed": result.endorsed,
+                "author": result.author,
+                "feedback": {k: v.value for k, v in result.feedback.items()},
+            },
+        )
+        self._count("/v1/consensus", 200)
+
+    # -- plumbing -------------------------------------------------------
+
+    def _observe_generation(
+        self, ttft: float | None, dt: float, num_tokens: int
+    ) -> None:
+        if ttft is not None:
+            self._m_ttft.observe(ttft)
+        self._m_latency.observe(dt)
+        if dt > 0 and num_tokens:
+            self._m_tps.observe(num_tokens / dt)
+
+    def _error_response(self, e: Exception):
+        if isinstance(e, QueueFullError):
+            return (
+                429,
+                {"error": str(e), "retry_after": e.retry_after},
+                {"Retry-After": str(max(1, round(e.retry_after)))},
+            )
+        if isinstance(e, DrainingError):
+            return 503, {"error": str(e)}, {"Retry-After": "5"}
+        if isinstance(e, DeadlineExpiredError):
+            return 504, {"error": str(e)}, {}
+        if isinstance(e, BackendError):
+            return 502, {"error": str(e)}, {}
+        if isinstance(e, ValueError):
+            return 400, {"error": str(e)}, {}
+        log.exception("unexpected gateway error", exc_info=e)
+        return 500, {"error": f"internal error: {e}"}, {}
+
+    def _count(self, route: str, status: int) -> None:
+        self._m_requests.labels(route=route, status=str(status)).inc()
+
+    async def _respond_json(
+        self, writer, status: int, doc: dict, headers=None
+    ) -> None:
+        await self._respond_raw(
+            writer,
+            status,
+            json.dumps(doc).encode(),
+            "application/json",
+            headers,
+        )
+
+    async def _respond_raw(
+        self, writer, status: int, body: bytes, ctype: str, headers=None
+    ) -> None:
+        reason = _REASONS.get(status, "Unknown")
+        lines = [
+            f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(body)}",
+            "Connection: close",
+        ]
+        for k, v in (headers or {}).items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+
+    async def _start_sse(self, writer) -> None:
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        await writer.drain()
+
+    async def _sse_event(self, writer, doc: dict) -> None:
+        writer.write(f"data: {json.dumps(doc)}\n\n".encode())
+        await writer.drain()
+
+    async def _sse_done(self, writer) -> None:
+        writer.write(b"data: [DONE]\n\n")
+        await writer.drain()
+
+
+class GatewayThread:
+    """Run a :class:`Gateway` on a dedicated event loop in a daemon
+    thread — the embedding/test harness (the pytest suite drives the
+    gateway from synchronous code; a REPL process can serve on the side).
+
+    ``start()`` blocks until the port is bound; ``drain()`` triggers the
+    graceful SIGTERM path from any thread and joins."""
+
+    def __init__(self, gateway: Gateway):
+        self.gateway = gateway
+        self._stop: asyncio.Event | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._finished = threading.Event()
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="gateway", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        assert self.gateway.port is not None
+        return self.gateway.port
+
+    def _run(self) -> None:
+        async def main():
+            self._loop = asyncio.get_running_loop()
+            self._stop = asyncio.Event()
+            try:
+                await self.gateway.start()
+            finally:
+                self._started.set()
+            await self._stop.wait()
+            await self.gateway.drain()
+
+        try:
+            asyncio.run(main())
+        except BaseException as e:  # noqa: BLE001 - surfaced on start/drain
+            self._error = e
+        finally:
+            self._started.set()
+            self._finished.set()
+
+    def start(self) -> "GatewayThread":
+        self._thread.start()
+        self._started.wait(timeout=30)
+        if self.gateway.port is None:
+            raise RuntimeError(f"gateway failed to start: {self._error!r}")
+        return self
+
+    def drain(self, timeout: float = 60) -> None:
+        """Graceful shutdown from any thread; joins the loop thread."""
+        if self._loop is not None and not self._finished.is_set():
+            self._loop.call_soon_threadsafe(
+                lambda: self._stop.set() if self._stop else None
+            )
+        self._finished.wait(timeout=timeout)
+        self._thread.join(timeout=timeout)
+        if self._error is not None:
+            raise RuntimeError("gateway thread failed") from self._error

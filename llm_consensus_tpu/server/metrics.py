@@ -1,0 +1,304 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+The repo's only observability before this module was the ad-hoc
+:class:`llm_consensus_tpu.utils.tracing.Tracer` (in-process spans, pull
+by Python API). Serving needs the standard scrape surface instead: a
+registry of counters/gauges/histograms that the gateway exports at
+``GET /metrics`` in the Prometheus text format (version 0.0.4), so the
+same dashboards that watch any other fleet watch this one.
+
+Stdlib only, thread-safe (the scheduler/batcher mutate metrics from
+their worker threads while the asyncio gateway renders), and dependency
+free so the hot serving modules (:mod:`serving.scheduler`,
+:mod:`serving.continuous`, :mod:`consensus.coordinator`) can import it
+without pulling in the gateway or jax.
+
+Metric families are get-or-create by name — two schedulers in one
+process share one ``scheduler_requests_total`` — and support optional
+labels (``family.labels(priority="interactive").inc()``) for the
+per-priority admission series.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "LATENCY_BUCKETS",
+    "THROUGHPUT_BUCKETS",
+]
+
+# Seconds: spans ~1 ms .. 2 min, the TTFT / request-latency range of a
+# CPU FakeBackend test and a real chip alike.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+# Tokens/sec: spans a struggling CPU run .. a healthy chip fleet.
+THROUGHPUT_BUCKETS = (
+    1.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0,
+    10_000.0, 50_000.0, 100_000.0, 500_000.0,
+)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare, floats as repr."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in labels
+    )
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One labeled sample set; the lock is shared with the family."""
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+
+
+class Counter(_Child):
+    """Monotonically increasing count."""
+
+    def __init__(self, lock: threading.Lock):
+        super().__init__(lock)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(_Child):
+    """Arbitrary settable value (queue depths, slot occupancy)."""
+
+    def __init__(self, lock: threading.Lock):
+        super().__init__(lock)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram(_Child):
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    def __init__(self, lock: threading.Lock, buckets: tuple[float, ...]):
+        super().__init__(lock)
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(f"buckets must be sorted and non-empty: {buckets}")
+        self.buckets = tuple(float(b) for b in buckets)
+        # One slot per finite bucket + the +Inf overflow slot.
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(le, cumulative_count), ...] ending with (inf, total)."""
+        out, total = [], 0
+        with self._lock:
+            counts = list(self._counts)
+        for b, c in zip(self.buckets, counts):
+            total += c
+            out.append((b, total))
+        out.append((float("inf"), total + counts[-1]))
+        return out
+
+
+class _Family:
+    """A named metric and its labeled children."""
+
+    def __init__(self, name: str, help_: str, kind: str, **kw):
+        self.name = name
+        self.help = help_
+        self.kind = kind  # "counter" | "gauge" | "histogram"
+        self._kw = kw
+        self._lock = threading.Lock()
+        self._children: dict[tuple[tuple[str, str], ...], _Child] = {}
+
+    def _make(self) -> _Child:
+        if self.kind == "counter":
+            return Counter(self._lock)
+        if self.kind == "gauge":
+            return Gauge(self._lock)
+        return Histogram(self._lock, self._kw["buckets"])
+
+    def labels(self, **labels: str):
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make()
+        return child
+
+    # Label-less convenience: the family acts as its own single child.
+    def _default(self):
+        return self.labels()
+
+    def inc(self, n: float = 1.0) -> None:
+        self._default().inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._default().dec(n)
+
+    def set(self, v: float) -> None:
+        self._default().set(v)
+
+    def observe(self, v: float) -> None:
+        self._default().observe(v)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    @property
+    def count(self) -> int:
+        return self._default().count
+
+    @property
+    def sum(self) -> float:
+        return self._default().sum
+
+    def cumulative(self):
+        return self._default().cumulative()
+
+    def render(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            children = list(self._children.items())
+        for key, child in sorted(children):
+            ls = _label_str(key)
+            if isinstance(child, Histogram):
+                for le, cum in child.cumulative():
+                    le_s = "+Inf" if le == float("inf") else _fmt(le)
+                    lines.append(
+                        f"{self.name}_bucket"
+                        f"{_label_str(key + (('le', le_s),))} {cum}"
+                    )
+                lines.append(f"{self.name}_sum{ls} {_fmt(child.sum)}")
+                lines.append(f"{self.name}_count{ls} {child.count}")
+            else:
+                lines.append(f"{self.name}{ls} {_fmt(child.value)}")
+        return lines
+
+
+class MetricsRegistry:
+    """Get-or-create registry of metric families.
+
+    One process-wide instance (:data:`REGISTRY`) backs the default
+    instrumentation; tests that need isolation construct their own and
+    pass it to the gateway/admission layers.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    def _get(self, name: str, help_: str, kind: str, **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(name, help_, kind, **kw)
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}"
+                )
+            return fam
+
+    def counter(self, name: str, help_: str = "") -> _Family:
+        return self._get(name, help_, "counter")
+
+    def gauge(self, name: str, help_: str = "") -> _Family:
+        return self._get(name, help_, "gauge")
+
+    def histogram(
+        self,
+        name: str,
+        help_: str = "",
+        buckets: tuple[float, ...] = LATENCY_BUCKETS,
+    ) -> _Family:
+        return self._get(name, help_, "histogram", buckets=tuple(buckets))
+
+    def get(self, name: str) -> _Family | None:
+        return self._families.get(name)
+
+    def render(self) -> str:
+        """The full exposition — Prometheus text format 0.0.4."""
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        lines: list[str] = []
+        for fam in fams:
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat {name[{labels}]: value} map of counters/gauges plus
+        histogram ``_count``/``_sum`` — the assertion surface for tests."""
+        out: dict[str, float] = {}
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            with fam._lock:
+                children = list(fam._children.items())
+            for key, child in children:
+                ls = _label_str(key)
+                if isinstance(child, Histogram):
+                    out[f"{fam.name}_count{ls}"] = child.count
+                    out[f"{fam.name}_sum{ls}"] = child.sum
+                else:
+                    out[f"{fam.name}{ls}"] = child.value
+        return out
+
+
+#: The process-wide default registry (scrape target of ``GET /metrics``).
+REGISTRY = MetricsRegistry()

@@ -60,8 +60,34 @@ from llm_consensus_tpu.models.paged_cache import (
     write_prefill_kv,
 )
 from llm_consensus_tpu.models.transformer import decode_step_paged, prefill
+from llm_consensus_tpu.server.metrics import REGISTRY as _REG
 
 log = logging.getLogger(__name__)
+
+# Process-wide serving metrics (exported at the gateway's /metrics).
+_M_SUBMITTED = _REG.counter(
+    "serving_requests_total", "Requests submitted to the continuous batcher"
+)
+_M_COMPLETED = _REG.counter(
+    "serving_completed_total", "Requests retired by the continuous batcher"
+)
+_M_TOKENS = _REG.counter(
+    "serving_generated_tokens_total", "Tokens generated (incl. EOS)"
+)
+_M_STEPS = _REG.counter(
+    "serving_decode_steps_total", "Device decode steps executed"
+)
+_M_WAITING = _REG.gauge(
+    "serving_waiting", "Requests waiting for a continuous-batcher slot"
+)
+_M_ACTIVE = _REG.gauge(
+    "serving_active_slots", "Continuous-batcher slots currently decoding"
+)
+_M_OCCUPANCY = _REG.histogram(
+    "serving_slot_occupancy",
+    "Active slots per decode step (batch occupancy)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
 
 
 @dataclass
@@ -357,6 +383,8 @@ class ContinuousBatcher:
         )
         with self._lock:
             self._waiting.append(req)
+            _M_WAITING.set(len(self._waiting))
+        _M_SUBMITTED.inc()
         self._work.set()
         return req.future
 
@@ -492,6 +520,8 @@ class ContinuousBatcher:
             )
             with self._lock:
                 self._slots[free_slot] = slot
+                _M_WAITING.set(len(self._waiting))
+                _M_ACTIVE.set(sum(s is not None for s in self._slots))
             self._last_tokens[free_slot] = first
             self._seeds[free_slot] = req.seed
             self._counts[free_slot] = 1  # token 0 sampled from prefill
@@ -538,6 +568,9 @@ class ContinuousBatcher:
             self._slots[idx] = None
             self._completed += 1
             self._generated_tokens += len(slot.generated)
+            _M_ACTIVE.set(sum(s is not None for s in self._slots))
+        _M_COMPLETED.inc()
+        _M_TOKENS.inc(len(slot.generated))
         text = self._decoded_text(slot)
         # Engine stop contract: trim at the earliest occurrence of any
         # stop, removing the stop itself. num_tokens keeps the honest
@@ -584,6 +617,10 @@ class ContinuousBatcher:
         k = max(1, self.config.steps_per_sync)
         with self._lock:
             self._decode_steps += k
+            active = sum(s is not None for s in self._slots)
+        _M_STEPS.inc(k)
+        if active:
+            _M_OCCUPANCY.observe(active)
         next_np = np.asarray(next_tok)  # [slots, k] — THE host sync
         for i, slot in enumerate(self._slots):
             if slot is None:

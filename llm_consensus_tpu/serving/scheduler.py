@@ -38,8 +38,22 @@ from llm_consensus_tpu.backends.base import (
 )
 from llm_consensus_tpu.engine.engine import InferenceEngine
 from llm_consensus_tpu.engine.sampler import SamplerConfig
+from llm_consensus_tpu.server.metrics import REGISTRY as _REG
 
 log = logging.getLogger(__name__)
+
+# Process-wide serving metrics (exported at the gateway's /metrics).
+_M_SUBMITTED = _REG.counter(
+    "scheduler_requests_total", "Requests submitted to the batch scheduler"
+)
+_M_DEPTH = _REG.gauge(
+    "scheduler_queue_depth", "Requests pending in the batch scheduler"
+)
+_M_OCCUPANCY = _REG.histogram(
+    "scheduler_batch_occupancy",
+    "Requests packed per executed scheduler batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
 
 
 @dataclass
@@ -118,6 +132,8 @@ class BatchScheduler:
         with self._lock:
             rid = next(self._ids)
             self._pending[rid] = pend
+            _M_DEPTH.set(len(self._pending))
+        _M_SUBMITTED.inc()
         self._q_push({"id": rid})
         return pend.future
 
@@ -158,8 +174,10 @@ class BatchScheduler:
                 for rid in batch_ids
                 if rid in self._pending
             ]
+            _M_DEPTH.set(len(self._pending))
         if not pends:
             return
+        _M_OCCUPANCY.observe(len(pends))
         # Group by static sampling config (one compiled program each).
         groups: dict[tuple, list[tuple[int, _Pending]]] = {}
         for rid, pend in pends:

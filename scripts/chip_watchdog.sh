@@ -1,15 +1,17 @@
 #!/bin/bash
 # Probe the tunnel chip every 5 min; log status. (Round-4 pattern: the
 # chip can go unresponsive for hours; queue legs block until it heals.)
+# Probe body = bench._PROBE_SRC, the ONE time-salted copy: the tunnel
+# replays previously-seen (executable, inputs) pairs across processes,
+# so a fixed-operand matmul can "pass" from the replay cache with the
+# chip dead. The salt makes every attempt's inputs fresh.
 cd /root/repo || exit 1
 while true; do
   ts=$(date -u +%H:%M:%S)
   out=$(timeout 90 python -c "
-import jax, numpy as np, jax.numpy as jnp
-d = jax.devices()[0]
-x = jnp.full((8,8), 2.0)
-v = float(np.asarray(x @ x)[0,0])
-print(f'ok {d.platform} {v}')
+import bench, jax
+exec(bench._PROBE_SRC)
+print(f'ok {jax.devices()[0].platform}')
 " 2>/dev/null | tail -1)
   echo "$ts ${out:-TIMEOUT(90s)}" >> runs/chip_watchdog.log
   sleep 300

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Tier-1 verify gate — the ONE entry point for local and automated runs.
+# Wraps the ROADMAP.md "Tier-1 verify" command verbatim (CPU, -m 'not
+# slow'); keep the two in sync by editing ROADMAP.md first. Exit code is
+# pytest's; DOTS_PASSED echoes the per-test pass count the growth driver
+# compares against the seed.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 1080 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

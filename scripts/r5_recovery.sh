@@ -8,11 +8,12 @@ mkdir -p runs/reports
 exec >> runs/r5_recovery.log 2>&1
 
 probe() {
-  timeout 90 python - <<'EOF' >/dev/null 2>&1
-import jax, numpy as np, jax.numpy as jnp
-x = jnp.full((128, 128), 2.0)
-assert float(np.asarray(x @ x)[0, 0]) == 512.0
-EOF
+  # The ONE probe body bench.py owns (bench._PROBE_SRC): its operand is
+  # time-salted per attempt, because the tunnel replays previously-seen
+  # (executable, inputs) pairs across processes — a fixed-operand probe
+  # can "pass" straight from the replay cache with the chip dead.
+  timeout 90 python -c 'import bench; exec(bench._PROBE_SRC)' \
+    >/dev/null 2>&1
 }
 
 wait_chip() {
@@ -26,11 +27,27 @@ wait_chip() {
 
 leg() {  # leg <artifact> <cmd...>
   art=$1; shift
+  # An artifact recording an unreachable chip is a FAILED measurement
+  # left by an earlier pass — drop it so this pass retries instead of
+  # SKIPping past the enshrined 0.0 record (the round-4 failure mode).
+  if [ -e "$art" ] && grep -q 'CHIP UNREACHABLE' "$art"; then
+    echo "LEG $art: stale CHIP UNREACHABLE artifact — removing to retry"
+    rm -f "$art"
+  fi
   [ -s "$art" ] && { echo "SKIP (have $art)"; return 0; }
   wait_chip
   echo "LEG $art: $* [$(date -u +%H:%M:%S)]"
   "$@"
-  echo "LEG $art done rc=$? [$(date -u +%H:%M:%S)]"
+  rc=$?
+  # A failed measurement is NOT done: drop the artifact when the leg's
+  # real exit code is nonzero or the artifact records an unreachable
+  # chip, so the next pass retries the leg instead of SKIPping past an
+  # enshrined 0.0 record (the round-4 failure mode).
+  if [ "$rc" -ne 0 ] || { [ -e "$art" ] && grep -q 'CHIP UNREACHABLE' "$art"; }; then
+    echo "LEG $art FAILED rc=$rc — removing artifact so a re-run retries"
+    rm -f "$art"
+  fi
+  echo "LEG $art done rc=$rc [$(date -u +%H:%M:%S)]"
 }
 
 date -u

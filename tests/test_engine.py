@@ -505,6 +505,71 @@ def test_engine_prefix_matches_plain_and_caches(tiny):
     assert got2 == want
 
 
+def test_engine_prefix_moe_straddles_dense_threshold():
+    """MoE dispatch parity when the prefix-cache token budget straddles
+    ``moe_dense_decode_tokens``: the pow2 prefix bucket (32 for a
+    21-token header) overshoots the threshold the true total sits
+    under. The path choice must come from the TRUE prefix length so the
+    prefix-cache path picks dense exactly when the plain concatenated
+    path does — at a tight capacity factor the capacity path DROPS
+    tokens, so a bucket-width budget is a real numeric divergence, not
+    a rounding quirk."""
+    tok = ByteTokenizer()
+    base = get_config("test-tiny-moe")
+    cfg = base.with_(moe_capacity_factor=1.0, moe_dense_decode_tokens=64)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = InferenceEngine(
+        cfg,
+        params,
+        engine_config=EngineConfig(
+            max_new_tokens=6, seq_buckets=(8, 32), batch_buckets=(1, 2, 4)
+        ),
+    )
+    prefix = "Shared header text. "  # 20 bytes + BOS = 21 tokens
+    prompts = ["2+2=", "3+3="]  # 4 tokens each (no BOS), suffix bucket 8
+    p = len(tok.encode(prefix))
+    pb = 1 << (p - 1).bit_length()  # the prefix cache's pow2 bucket
+    true_total = 2 * (p + 8)
+    bucket_total = 2 * (pb + 8)
+    # Scenario self-check: the true budget is dense-side, the bucketed
+    # one capacity-side — i.e. the threshold is genuinely straddled (a
+    # bucket-geometry drift would otherwise make this test vacuous).
+    assert cfg.moe_dense_at(true_total) and not cfg.moe_dense_at(bucket_total)
+
+    got = eng.generate_texts(
+        prompts, prefix=prefix, temperatures=[0.0, 0.0], seed=7
+    )
+    assert eng.prefix_cache.stats.misses == 1  # prefix path actually taken
+
+    # Sharp check: the same prefix-path call under a config pinned dense
+    # at EVERY shape traces the identical program when the straddling
+    # config resolves dense too — bitwise-equal logprobs. The capacity
+    # path diverges by ~1e-2 here (tight factor drops tokens), far
+    # outside this tolerance, so a bucket-width budget fails this.
+    dense = InferenceEngine(
+        cfg.with_moe_dense_up_to(cfg.max_seq_len**2),
+        params,
+        engine_config=eng.config,
+    )
+    want = dense.generate_texts(
+        prompts, prefix=prefix, temperatures=[0.0, 0.0], seed=7
+    )
+    assert [r.text for r in got] == [r.text for r in want]
+    np.testing.assert_allclose(
+        [r.logprob for r in got], [r.logprob for r in want], atol=1e-6
+    )
+
+    # And the plain concatenated path still agrees on the texts (its
+    # own budget, 2 x 32 = 64, is dense-side as well).
+    plain = [
+        r.text
+        for r in eng.generate_texts(
+            [prefix + q for q in prompts], temperatures=[0.0, 0.0], seed=7
+        )
+    ]
+    assert [r.text for r in got] == plain
+
+
 def test_engine_prefix_kv_quant_rides_cache(tiny):
     """Quant-KV engines now ride the prefix cache (miss once, hit after,
     deterministic continuation). Text equality with the plain quant path
