@@ -190,6 +190,25 @@ def main() -> int:
         "dispatch+fetch per chunk, and on a tunneled chip that RTT "
         "dominates the decode step itself",
     )
+    p.add_argument(
+        "--serve-shared-prefix",
+        action="store_true",
+        help="serving bench variant (implies --serve): every request "
+        "shares one ~prompt_len-token prefix + a short unique suffix — "
+        "the consensus-panel shape. Exercises copy-on-write prefix "
+        "sharing + chunked prefill; reports prefix pages "
+        "shared/copied, registry hit rate, and the prefill-stall "
+        "histogram next to requests/sec (compare against the r5 "
+        "chunk-1/chunk-16 --serve rows)",
+    )
+    p.add_argument(
+        "--serve-prefill-chunk",
+        type=int,
+        default=64,
+        help="prefill-chunk width for the serving bench "
+        "(ContinuousConfig.prefill_chunk; 0 = legacy blocking dense "
+        "prefill at admission)",
+    )
     args = p.parse_args()
 
     if args.cpu:
@@ -323,7 +342,7 @@ def main() -> int:
 
     if args.draft:
         return _bench_speculative(args, cfg, params, tokens, lengths)
-    if args.serve:
+    if args.serve or args.serve_shared_prefix:
         return _bench_serving(args, cfg, params)
 
     # Synchronization caveat on this tunnel runtime: blocking a SINGLE
@@ -536,7 +555,10 @@ def _bench_serving(args, cfg, params) -> int:
     # bucket, which would silently bench a smaller workload than the
     # metric string claims).
     buckets = [64]
-    while buckets[-1] < args.prompt_len:
+    shared = args.serve_shared_prefix
+    # Shared-prefix leg: prefix (~prompt_len) + unique suffix must fit.
+    cap_target = args.prompt_len + (64 if shared else 0)
+    while buckets[-1] < cap_target:
         buckets.append(buckets[-1] * 2)
     # + chunk - 1: rows finishing mid-chunk overshoot into their pages.
     pages_per_seq = -(
@@ -554,17 +576,32 @@ def _bench_serving(args, cfg, params) -> int:
             max_new_tokens=args.new_tokens,
             seq_buckets=tuple(buckets),
             steps_per_sync=args.serve_chunk,
+            prefill_chunk=args.serve_prefill_chunk,
+            share_prefix=shared,
         ),
     )
     # Salted prompts (the tunnel runtime replays previously-seen
     # (executable, inputs) pairs — see main()); byte tokenizer: 1 token
     # per byte, so pad with 13-byte repeats to ~prompt_len tokens.
     salt = int(time.time() * 1e6) % 999983
-    prompts = [
-        f"Request {salt}-{i}: summarize item {i * 37 % 101} "
-        + "with context " * (max(0, args.prompt_len - 40) // 13)
-        for i in range(args.serve_requests)
-    ]
+    if shared:
+        # The consensus-panel shape: one ~prompt_len-token shared
+        # header, a short unique question tail per request. The header
+        # should prefill once (first admission) and page-share into the
+        # other serve_requests-1 tables.
+        header = f"Panel header {salt}: " + "shared context " * (
+            max(0, args.prompt_len - 24) // 15
+        )
+        prompts = [
+            header + f"Q{i}: item {i * 37 % 101}?"
+            for i in range(args.serve_requests)
+        ]
+    else:
+        prompts = [
+            f"Request {salt}-{i}: summarize item {i * 37 % 101} "
+            + "with context " * (max(0, args.prompt_len - 40) // 13)
+            for i in range(args.serve_requests)
+        ]
     try:
         # Warmup: compile prefill buckets + the decode-step program. A
         # prompt OUTSIDE the burst set — re-running an identical prompt
@@ -576,7 +613,14 @@ def _bench_serving(args, cfg, params) -> int:
         batcher.submit(warm, max_new_tokens=args.new_tokens).result(
             timeout=600
         )
-        steps_before = batcher.stats()["decode_steps"]
+        before = batcher.stats()
+        if shared:
+            from llm_consensus_tpu.server.metrics import REGISTRY as _SREG
+
+            _stall = _SREG.get("gateway_prefill_stall_seconds")
+            stall_before = (
+                (_stall.sum, _stall.count) if _stall else (0.0, 0)
+            )
         t0 = time.perf_counter()
         futs = [
             batcher.submit(p, max_new_tokens=args.new_tokens)
@@ -588,18 +632,41 @@ def _bench_serving(args, cfg, params) -> int:
         batcher.close()
     n_tokens = sum(r.num_tokens for r in results)
     rps = len(results) / wall
-    # Timed-window step count only (warmup decoded solo before t0).
-    steps = batcher.stats()["decode_steps"] - steps_before
+    after = batcher.stats()
+    # Timed-window deltas only (warmup decoded solo before t0).
+    steps = after["decode_steps"] - before["decode_steps"]
+    prefix_note = ""
+    if shared:
+        pages_shared = (
+            after["prefix_pages_shared"] - before["prefix_pages_shared"]
+        )
+        hits = after["prefix_hits"] - before["prefix_hits"]
+        looks = after["prefix_lookups"] - before["prefix_lookups"]
+        # Timed-window delta: the warmup prompt's prefill (and the first
+        # chunk program's COMPILE, orders of magnitude above steady
+        # state) already sits in the process-wide histogram.
+        d_sum = (_stall.sum if _stall else 0.0) - stall_before[0]
+        d_cnt = (_stall.count if _stall else 0) - stall_before[1]
+        stall_ms = 1e3 * d_sum / d_cnt if d_cnt else 0.0
+        prefix_note = (
+            f", prefix: {pages_shared} pages shared / "
+            f"{after['prefix_pages_copied'] - before['prefix_pages_copied']}"
+            f" copied, hit {hits}/{looks}, "
+            f"chunks={after['prefill_chunks'] - before['prefill_chunks']}, "
+            f"stall avg {stall_ms:.1f} ms"
+        )
     print(
         json.dumps(
             {
                 "metric": f"serving requests/sec ({cfg.name}, "
                 f"{args.serve_requests} reqs, slots={args.serve_slots}, "
-                f"decode {args.new_tokens} @ ~{args.prompt_len} prompt, "
-                f"chunk={args.serve_chunk}, "
+                f"decode {args.new_tokens} @ ~{args.prompt_len} prompt"
+                + (" SHARED" if shared else "")
+                + f", chunk={args.serve_chunk}, "
+                f"prefill_chunk={args.serve_prefill_chunk}, "
                 f"paged pallas={cfg.use_pallas}, "
                 f"{n_tokens / wall:.0f} generated tok/s, "
-                f"{steps} decode steps)",
+                f"{steps} decode steps{prefix_note})",
                 "value": round(rps, 2),
                 "unit": "requests/sec",
                 "vs_baseline": round(rps, 4),

@@ -74,10 +74,23 @@ def _load_checkpoint_params(cfg, path: str):
     return load_params(path)
 
 
+
+def _printable(text: str) -> str:
+    """Model output for stdout: lone surrogates (the ByteTokenizer's
+    reversible stand-ins for invalid bytes) render as U+FFFD instead of
+    crashing the terminal's strict UTF-8 encoder. Display-only — the
+    protocol/engine surfaces keep the exact reversible text."""
+    return "".join(
+        "\ufffd" if 0xD800 <= ord(ch) <= 0xDFFF else ch for ch in text
+    )
+
 def _build_backend(args):
     if args.backend == "fake":
         return FakeBackend()
-    # Local on-device inference. Import lazily: jax/device init is heavy
+    # Local on-device inference ("local" = engine whole-batch programs,
+    # "continuous" = token-level continuous batching over the paged
+    # cache with shared-prefix CoW page tables + chunked prefill).
+    # Import lazily: jax/device init is heavy
     # and the fake path must stay instant.
     import jax
 
@@ -135,6 +148,40 @@ def _build_backend(args):
         mesh = make_mesh(MeshConfig(**_parse_axes(args.mesh)))
         if mesh.shape.get("seq", 1) > 1:
             cfg = cfg.with_(use_ring=True)
+    if args.backend == "continuous":
+        from llm_consensus_tpu.serving.continuous import (
+            ContinuousBackend,
+            ContinuousBatcher,
+            ContinuousConfig,
+        )
+
+        if args.quant != "none":
+            # Same weight-only quantization the engine path applies
+            # (paged decode + chunk prefill read QuantizedTensor leaves
+            # through ops.quant.matmul exactly like the dense programs).
+            from llm_consensus_tpu.ops.quant import quantize_params
+
+            params = quantize_params(
+                params, bits=8 if args.quant == "int8" else 4
+            )
+        if draft is not None:
+            log.warning(
+                "--draft-model is ignored by --backend continuous "
+                "(speculative decoding rides the engine path only)"
+            )
+        batcher = ContinuousBatcher(
+            cfg,
+            params,
+            tokenizer=load_tokenizer(args.tokenizer),
+            config=ContinuousConfig(
+                max_slots=args.serve_slots,
+                max_new_tokens=args.max_new_tokens,
+                prefill_chunk=args.prefill_chunk,
+                share_prefix=not args.no_share_prefix,
+            ),
+            mesh=mesh,
+        )
+        return ContinuousBackend(batcher)
     engine = InferenceEngine(
         cfg,
         params,
@@ -152,7 +199,29 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
     """Backend-construction flags — the ONE definition of everything
     `_build_backend` reads, shared by the main parser and `serve` so the
     two cannot drift apart."""
-    p.add_argument("--backend", choices=["fake", "local"], default="fake")
+    p.add_argument(
+        "--backend", choices=["fake", "local", "continuous"], default="fake"
+    )
+    p.add_argument(
+        "--serve-slots",
+        type=int,
+        default=8,
+        help="continuous backend: decode slots (batch width of the "
+        "one compiled decode program)",
+    )
+    p.add_argument(
+        "--prefill-chunk",
+        type=int,
+        default=64,
+        help="continuous backend: prefill-chunk tokens interleaved "
+        "between decode steps (0 = legacy blocking prefill)",
+    )
+    p.add_argument(
+        "--no-share-prefix",
+        action="store_true",
+        help="continuous backend: disable copy-on-write shared-prefix "
+        "page dedup",
+    )
     p.add_argument(
         "--cpu",
         action="store_true",
@@ -348,7 +417,7 @@ async def repl(coord: Coordinator, stream=None) -> None:
         await coord.ask_question(question)
         answer = await coord.wait_for_answer()
         log.info("Final answer: %s", answer)
-        out.write(f"\n{answer}\n\n")
+        out.write(f"\n{_printable(answer)}\n\n")
         coord.reset()
 
 
@@ -482,7 +551,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     if args.question is not None:
         result = asyncio.run(coord.run(args.question))
-        print(result.answer)
+        print(_printable(result.answer))
         return 0
     asyncio.run(repl(coord))
     return 0
@@ -502,7 +571,7 @@ def _run_stream(args) -> int:
         seed=args.seed if args.seed is not None else 0,
         max_new_tokens=args.max_new_tokens,
     ):
-        print(piece, end="", flush=True)
+        print(_printable(piece), end="", flush=True)
     print()
     return 0
 
@@ -538,7 +607,7 @@ def _run_debate(args) -> int:
         result.total_tokens,
         result.vote.tally,
     )
-    print(result.answer)
+    print(_printable(result.answer))
     return 0
 
 

@@ -82,7 +82,12 @@ class EngineConfig:
     kv_quant: bool = False
     # > 0: prefill prompts longer than this in fixed-size chunks
     # (models/transformer.prefill_chunked) — bounded activation memory
-    # for long contexts; bf16 cache only.
+    # for long contexts. Composes with kv_quant: each chunk's K/V is
+    # quantized at scatter time with the same per-(token, head) scale
+    # granularity as the one-shot quant prefill, so the written cache is
+    # bit-identical; only the chunk's attention reads go through the
+    # dequantized slab (first-token logits differ from one-shot by int8
+    # rounding only).
     prefill_chunk: int = 0
     # Host-side prefix cache (engine/prefix_cache.py): shared prompt
     # prefixes (few-shot headers, debate transcripts) are prefilled once
@@ -152,12 +157,6 @@ class InferenceEngine:
             )
         elif self.config.quant != "none":
             raise ValueError(f"unknown quant mode {self.config.quant!r}")
-        if self.config.prefill_chunk > 0 and self.config.kv_quant:
-            # Silent one-shot fallback would unbound exactly the memory
-            # prefill_chunk exists to bound; surface the conflict now.
-            raise ValueError(
-                "prefill_chunk requires the bf16 KV cache (kv_quant=False)"
-            )
         # Optional draft model for generate_texts_speculative: a
         # (config, params) pair sharing this model's tokenizer/vocab.
         self.draft = draft
@@ -1046,11 +1045,7 @@ class InferenceEngine:
             lengths_j = jax.device_put(lengths_j, self._data_sharding)
             temps = jax.device_put(temps, self._data_sharding)
             cache = jax.device_put(cache, self._cache_sharding(cache))
-        if (
-            self.config.prefill_chunk
-            and s > self.config.prefill_chunk
-            and not self.config.kv_quant
-        ):
+        if self.config.prefill_chunk and s > self.config.prefill_chunk:
             logits, cache = _jit_prefill_chunked(
                 self.cfg, self.params, tokens_j, lengths_j, cache,
                 chunk=self.config.prefill_chunk,

@@ -158,15 +158,16 @@ def _prefill_into_cache(
 
     def _prefill(p_tokens, p_lengths, p_cache):
         # Chunked prefill (bounded activation memory for long prompts)
-        # applies on the bf16 cache when the prompt exceeds the chunk;
-        # it is exactness-tested against the one-shot path. A seq-mesh
-        # (ring attention) takes precedence: the ring IS the long-
-        # context memory strategy there, and the chunk pass has no
-        # sequence-parallel path.
+        # applies when the prompt exceeds the chunk; exactness-tested
+        # against the one-shot path (bit-equal on the bf16 cache; int8
+        # rounding-bounded on the quant cache, whose chunk scatter
+        # quantizes at the same per-(token, head) granularity as the
+        # one-shot write). A seq-mesh (ring attention) takes
+        # precedence: the ring IS the long-context memory strategy
+        # there, and the chunk pass has no sequence-parallel path.
         if (
             prefill_chunk > 0
             and p_tokens.shape[1] > prefill_chunk
-            and not kv_quant
             and mesh is None
         ):
             return prefill_chunked(

@@ -53,7 +53,14 @@ class ByteTokenizer(Tokenizer):
         self.vocab_size = 256 + self._offset
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
-        ids = [b + self._offset for b in text.encode("utf-8")]
+        # surrogateescape mirrors decode(): text carved out of decoded
+        # model output (stop sequences, prefix keys) may carry lone
+        # surrogates standing in for invalid bytes; encoding them back
+        # to those bytes keeps encode(decode(ids)) == ids.
+        ids = [
+            b + self._offset
+            for b in text.encode("utf-8", errors="surrogateescape")
+        ]
         return [self.bos_id] + ids if add_bos else ids
 
     def decode(self, ids: Sequence[int]) -> str:
@@ -64,7 +71,16 @@ class ByteTokenizer(Tokenizer):
             for i in ids
             if self._offset <= i < self._offset + 256
         )
-        return data.decode("utf-8", errors="replace")
+        # surrogateescape, not replace: invalid bytes must decode to
+        # DISTINCT characters (U+DC80+byte) or the decode is lossy in a
+        # way that breaks stop-sequence position arithmetic — with
+        # errors="replace" every invalid byte aliases to U+FFFD, so a
+        # stop string carved from decoded text str.find()-matches at an
+        # EARLIER aliased position and the trim cuts the wrong prefix
+        # (the engine/batcher stop contract trims at the earliest true
+        # occurrence). surrogateescape is also reversible, preserving
+        # the class promise that decode round-trips arbitrary bytes.
+        return data.decode("utf-8", errors="surrogateescape")
 
 
 class HFTokenizer(Tokenizer):

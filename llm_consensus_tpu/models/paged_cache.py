@@ -19,11 +19,28 @@ Layout:
 - length: ``[max_seqs]`` tokens written per sequence.
 
 Page 0 is reserved as the "null" page so freshly-reset tables are valid.
+
+Two host-side structures complete the picture (PR 2):
+
+- :class:`PagePool` — refcounted page allocator. A page mapped into N
+  live page tables (plus optionally the prefix registry) carries
+  refcount N(+1) and returns to the free list only when the last holder
+  releases it, which is what makes COPY-ON-WRITE page sharing safe:
+  full pages of a common prompt prefix are *mapped*, never rewritten
+  (decode writes only at positions >= prompt_len, i.e. never into a
+  fully-shared prefix page), and any page that WOULD be written —
+  the partially-filled boundary page — is copied, never shared.
+- :class:`PrefixRegistry` — a radix tree of page-aligned prompt
+  prefixes keyed by page-sized token runs, so the consensus panel's N
+  requests over one question prefill the shared header once and every
+  later admission maps the already-resident pages.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -151,3 +168,328 @@ def release_seq(cache: PagedKVCache, seq_id: jnp.ndarray) -> PagedKVCache:
     return PagedKVCache(
         k=cache.k, v=cache.v, page_table=table, length=length
     )
+
+
+def install_seq(
+    cache: PagedKVCache,
+    seq_id: jnp.ndarray,
+    pages: jnp.ndarray,
+    length: jnp.ndarray,
+) -> PagedKVCache:
+    """Install table AND length for one sequence in one pass — the
+    moment a chunk-prefilled sequence (whose pages were written through
+    an explicit host-side table, invisible to the decode program)
+    becomes a live decode row."""
+    table = cache.page_table.at[seq_id].set(pages.astype(jnp.int32))
+    new_len = cache.length.at[seq_id].set(length.astype(jnp.int32))
+    return PagedKVCache(
+        k=cache.k, v=cache.v, page_table=table, length=new_len
+    )
+
+
+def copy_page(
+    cache: PagedKVCache, src: jnp.ndarray, dst: jnp.ndarray
+) -> PagedKVCache:
+    """Copy one page's K/V across all layers (``src`` -> ``dst``).
+
+    The copy-on-write primitive: when an admission's prompt shares a
+    registered prefix that ends INSIDE a page, that boundary page's
+    already-computed K/V is copied into a freshly-allocated private
+    page — sharing it would let this sequence's later prefill/decode
+    writes corrupt every other reader.
+    """
+    k = cache.k.at[:, dst].set(cache.k[:, src])
+    v = cache.v.at[:, dst].set(cache.v[:, src])
+    return PagedKVCache(
+        k=k, v=v, page_table=cache.page_table, length=cache.length
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side allocation: refcounted pages + prefix radix tree
+# ---------------------------------------------------------------------------
+
+
+class PagePool:
+    """Refcounted host-side page allocator over a fixed id range.
+
+    Callers hold pages by id; a page is free exactly when its refcount
+    is zero. Fresh allocations start at refcount 1; mapping an existing
+    page into another sequence's table goes through :meth:`share`;
+    every holder (sequences AND the prefix registry) pairs its hold
+    with exactly one :meth:`release`. Not thread-safe — callers
+    serialize under their own lock (the continuous batcher's worker
+    owns its pools).
+    """
+
+    def __init__(self, page_ids: Iterable[int]):
+        self._free: deque[int] = deque(page_ids)
+        self._rc: dict[int, int] = {}
+
+    @property
+    def available(self) -> int:
+        """Pages allocatable right now (excludes shared/cached pages)."""
+        return len(self._free)
+
+    @property
+    def held(self) -> int:
+        return len(self._rc)
+
+    def refcount(self, page: int) -> int:
+        return self._rc.get(page, 0)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: want {n}, have {len(self._free)}"
+            )
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            self._rc[p] = 1
+        return pages
+
+    def share(self, page: int) -> None:
+        if page not in self._rc:
+            raise ValueError(f"page {page} is not allocated")
+        self._rc[page] += 1
+
+    def release(self, page: int) -> None:
+        rc = self._rc.get(page)
+        if rc is None:
+            raise ValueError(f"page {page} is not allocated")
+        if rc == 1:
+            del self._rc[page]
+            self._free.append(page)
+        else:
+            self._rc[page] = rc - 1
+
+
+@dataclass
+class _PrefixNode:
+    """One page-sized token run in the prefix radix tree."""
+
+    tokens: tuple[int, ...]
+    page: int
+    parent: "_PrefixNode | None"
+    children: dict[tuple[int, ...], "_PrefixNode"] = field(
+        default_factory=dict
+    )
+    # Content of ``page`` is fully written (the registering sequence's
+    # prefill has passed this page's end). Readers — a matching
+    # admission's chunk prefill, the boundary-page copy — must wait for
+    # this flag; the page ids themselves are safe to map immediately.
+    ready: bool = False
+    # LRU tick for eviction (registry-maintained).
+    last_used: int = 0
+
+
+@dataclass
+class PrefixMatch:
+    """What an admission gets back from :meth:`PrefixRegistry.match`."""
+
+    pages: list[int]  # full shared pages, prefix order (refs bumped)
+    nodes: list[_PrefixNode]  # their nodes (readiness gates)
+    shared_tokens: int  # len(pages) * page_size
+    # Boundary page eligible for copy-on-write: its first
+    # ``boundary_common`` tokens extend this prompt's prefix past the
+    # full-page match. None when no partially-matching sibling exists
+    # or its content is not ready yet (copying garbage helps nobody).
+    boundary_page: int | None = None
+    boundary_common: int = 0
+
+
+class PrefixRegistry:
+    """Radix tree of page-aligned prompt prefixes over one PagePool.
+
+    Nodes are keyed by the exact token tuple of each page-sized run, so
+    lookup is a dict walk (no hashing subtleties — the token run IS the
+    key). The registry holds one refcount on every node's page; match
+    bumps refcounts for the caller (caller releases per page on
+    retirement, exactly like privately-allocated pages).
+
+    Registration happens at ADMISSION (before content exists) so that a
+    burst of same-prefix requests — the consensus panel — dedups
+    against the FIRST request's in-flight prefill instead of racing it;
+    ``_PrefixNode.ready`` gates content readers.
+    """
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = page_size
+        self._root = _PrefixNode(tokens=(), page=NULL_PAGE, parent=None)
+        self._nodes = 0
+        self._tick = 0
+        # Monotonic counters (the serving layer exports these).
+        self.lookups = 0
+        self.hits = 0
+        self.pages_shared = 0
+        self.pages_copied = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    @property
+    def cached_pages(self) -> int:
+        return self._nodes
+
+    def reclaimable_pages(self) -> int:
+        """Registry pages held by nobody else — freeable via evict()."""
+        return sum(
+            1
+            for node in self._walk()
+            if self.pool.refcount(node.page) == 1
+        )
+
+    def _walk(self):
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            yield node
+
+    def match(self, ids: Sequence[int], min_boundary: int = 1) -> PrefixMatch:
+        """Longest registered page-aligned prefix of ``ids``.
+
+        Sharing is capped at ``len(ids) - 1`` tokens: at least the last
+        prompt token must be (re)computed so the admission has a hidden
+        state to sample the first token from. Matched pages' refcounts
+        are bumped FOR THE CALLER (release per page on retirement).
+        The boundary page (a sibling run extending the match part-way)
+        is reported for copy-on-write but NOT ref-bumped — the caller
+        copies content, so it allocates its own destination page.
+
+        ``min_boundary``: smallest common run worth a page copy —
+        below it the caller recomputes those tokens anyway, and a
+        trivial overlap (every prompt shares BOS) must not trigger a
+        copy per admission.
+        """
+        pg = self.page_size
+        self.lookups += 1
+        self._tick += 1
+        node = self._root
+        pages: list[int] = []
+        nodes: list[_PrefixNode] = []
+        # Only prefixes strictly shorter than the prompt are usable.
+        usable_full = (len(ids) - 1) // pg
+        k = 0
+        while k < usable_full:
+            key = tuple(int(t) for t in ids[k * pg : (k + 1) * pg])
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = self._tick
+            self.pool.share(child.page)
+            pages.append(child.page)
+            nodes.append(child)
+            node = child
+            k += 1
+        match = PrefixMatch(
+            pages=pages,
+            nodes=nodes,
+            shared_tokens=k * pg,
+        )
+        # Boundary: a child run whose first tokens extend our prefix
+        # but diverge (or run past our prompt) before the page ends.
+        rem = tuple(int(t) for t in ids[k * pg :])
+        cap = len(rem) - 1  # leave >= 1 token to prefill
+        if cap > 0:
+            best, best_child = 0, None
+            for key, child in node.children.items():
+                if not child.ready:
+                    continue
+                common = 0
+                for a, b in zip(key, rem):
+                    if a != b:
+                        break
+                    common += 1
+                if common > best:
+                    best, best_child = common, child
+            if best_child is not None and min(best, cap) >= min_boundary:
+                best_child.last_used = self._tick
+                match.boundary_page = best_child.page
+                match.boundary_common = min(best, cap)
+        return match
+
+    def record_commit(self, match: PrefixMatch, copied: bool) -> None:
+        """Count a match the caller actually ADMITTED on. Kept separate
+        from :meth:`match` so a plan that rolls back (pool too full,
+        table overflow) never inflates hits/pages_shared — the numbers
+        stats()/bench report must agree with the Prometheus counters,
+        which also count only committed admissions."""
+        if match.pages or match.boundary_common:
+            self.hits += 1
+        self.pages_shared += len(match.pages)
+        if copied:
+            self.pages_copied += 1
+
+    def register(
+        self, ids: Sequence[int], pages: Sequence[int]
+    ) -> list[tuple[_PrefixNode, int]]:
+        """Offer a sequence's full prompt pages to the tree.
+
+        ``pages[i]`` must hold tokens ``ids[i*pg : (i+1)*pg]`` (or be
+        about to — see readiness). Runs already present are skipped (the
+        existing node keeps its page; ours stays private). Returns the
+        [(node, end_position)] list of NEWLY created nodes the caller
+        must mark ready (:meth:`mark_ready`) as its prefill writes past
+        each ``end_position``.
+        """
+        pg = self.page_size
+        self._tick += 1
+        node = self._root
+        created: list[tuple[_PrefixNode, int]] = []
+        full = min(len(ids) // pg, len(pages))
+        for k in range(full):
+            key = tuple(int(t) for t in ids[k * pg : (k + 1) * pg])
+            child = node.children.get(key)
+            if child is None:
+                self.pool.share(pages[k])  # the registry's own hold
+                child = _PrefixNode(
+                    tokens=key, page=pages[k], parent=node
+                )
+                node.children[key] = child
+                self._nodes += 1
+                created.append((child, (k + 1) * pg))
+            child.last_used = self._tick
+            node = child
+        return created
+
+    @staticmethod
+    def mark_ready(node: _PrefixNode) -> None:
+        node.ready = True
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` registry-only pages (LRU leaves first).
+
+        Only leaves whose page nobody else holds are dropped — evicting
+        a page mapped into a live sequence would free nothing and
+        forfeit future sharing. One tree walk total (this runs inside
+        the batcher's admission lock): eligible leaves are collected
+        once into an LRU heap, and a parent enters the heap only when
+        evicting its last child exposes it. Returns pages freed.
+        """
+        import heapq
+
+        heap = [
+            (node.last_used, id(node), node)
+            for node in self._walk()
+            if not node.children and self.pool.refcount(node.page) == 1
+        ]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < n_pages:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            del parent.children[victim.tokens]
+            self.pool.release(victim.page)
+            self._nodes -= 1
+            self.evictions += 1
+            freed += 1
+            if (
+                parent is not self._root
+                and not parent.children
+                and self.pool.refcount(parent.page) == 1
+            ):
+                heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        return freed

@@ -1011,6 +1011,89 @@ def decode_step_paged(
     return logits, new_cache
 
 
+def prefill_chunk_paged(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    table: jnp.ndarray,
+    start: jnp.ndarray,
+    cache,
+) -> tuple[jnp.ndarray, object]:
+    """One prompt chunk for ONE sequence, scattered into paged K/V.
+
+    tokens: [1, C] — chunk token ids at absolute positions
+    ``start + i``; table: [pages_per_seq] int32 page ids (position p
+    lives in ``table[p // page_size]`` at offset ``p % page_size``);
+    start: scalar int32. Writes each chunk token's K/V through
+    ``table`` and attends over the table's content so far plus the
+    chunk itself — the same ragged-causal rule as
+    :func:`decode_chunk`, so a sequence of chunk calls writes the
+    identical cache a dense :func:`prefill` + scatter would.
+
+    The table rides as an ARGUMENT, not through ``cache.page_table``:
+    a mid-prefill sequence must stay invisible to the concurrently
+    running decode program (its device table row stays NULL until the
+    last chunk lands — see serving/continuous). This is also what lets
+    chunk positions start past zero: a shared page-aligned prefix (and
+    an optionally copied boundary page) already populates the table's
+    head, and this program only ever writes positions >= ``start``, so
+    refcount-shared pages are read, never written.
+
+    Returns ([1, C, D] hidden states, cache). ``cache.page_table`` and
+    ``cache.length`` are untouched. The serving layer gathers the
+    last-valid position's hidden state from the FINAL chunk and
+    unembeds that single row (see :func:`unembed_one`) — never a
+    [C, V] logits buffer per chunk.
+    """
+    from llm_consensus_tpu.models.paged_cache import PagedKVCache
+
+    c = tokens.shape[1]
+    pos = start + jnp.arange(c)  # [C] absolute positions
+    x = params["embed"][tokens]  # [1, C, D]
+    cos, sin = rope_cos_sin(
+        pos[None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+    pg = cache.page_size
+    pages = table[pos // pg]  # [C] destination page per chunk token
+    offs = pos % pg
+    valid = start[None]  # [1] pre-chunk fill for ragged-causal masking
+
+    def body(carry, layer_in):
+        p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
+        h = _rms(cfg, carry, p["attn_norm"])
+        q, k, v = _project_qkv(cfg, p, h)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pool = k_pool.at[pages, offs].set(k[0].astype(k_pool.dtype))
+        v_pool = v_pool.at[pages, offs].set(v[0].astype(v_pool.dtype))
+        # Flattened table gather: slot j of the [P*page] axis IS
+        # absolute position j (table[i] holds positions [i*pg, (i+1)*pg)),
+        # exactly the layout chunk_decode_attention's ragged rule masks.
+        k_seq = k_pool[table].reshape(1, -1, cfg.n_kv_heads, cfg.head_dim)
+        v_seq = v_pool[table].reshape(1, -1, cfg.n_kv_heads, cfg.head_dim)
+        attn = chunk_decode_attention(
+            q, k_seq, v_seq, valid, window=cfg.sliding_window
+        )
+        y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
+        h2 = _rms(cfg, y, p["mlp_norm"])
+        y = y + _mlp(cfg, p, h2)
+        return y, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    new_cache = PagedKVCache(
+        k=new_k, v=new_v, page_table=cache.page_table, length=cache.length
+    )
+    return x, new_cache
+
+
+def unembed_one(cfg: ModelConfig, params: dict, h: jnp.ndarray) -> jnp.ndarray:
+    """Logits [V] fp32 for ONE hidden state [D] — the final-chunk
+    unembed of the chunked-prefill path (a D x V matvec, not C x V)."""
+    return _unembed(cfg, params, h[None])[0]
+
+
 def decode_chunk(
     cfg: ModelConfig,
     params: dict,

@@ -1,0 +1,94 @@
+"""API-drift shims for the manual-sharding surface.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (and grew the ``axis_names`` kwarg replacing ``auto``,
+plus the VMA type system with ``jax.lax.pcast``); this repo targets
+whichever jax the container ships, so every manual-collective module
+(:mod:`parallel.ring`, :mod:`parallel.pipeline`,
+:mod:`consensus.voting`) resolves the API through HERE instead of
+hard-coding one spelling.
+
+The shim is resolved once at import: feature-detect, don't
+version-parse — jax backports and vendor forks make version strings
+unreliable.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "pcast_varying", "SUPPORTS_PARTIAL_AUTO"]
+
+_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+
+#: Partial-auto shard_map (manual over a subset of mesh axes, GSPMD
+#: auto-sharding over the rest). The experimental API's ``auto=``
+#: parameter exists but its lowering hard-crashes XLA's partitioner
+#: (``Check failed: sharding.IsManualSubgroup()``) for the GPipe
+#: schedule's ppermute-in-scan shape; only the ``axis_names`` API
+#: lowers it safely, so feature-gate on that.
+SUPPORTS_PARTIAL_AUTO = _NEW_SHARD_MAP
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
+    """``jax.shard_map`` with a fallback to the experimental spelling.
+
+    ``axis_names``: the MANUAL mesh axes (new-API meaning). On the old
+    API this maps to ``auto`` = every other mesh axis. ``None`` means
+    all axes manual (both APIs' default).
+    """
+    if _NEW_SHARD_MAP:
+        if axis_names is None:
+            return jax.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+            )
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            axis_names=set(axis_names),
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # check_rep=False everywhere: the callers mark varying scan carries
+    # via pcast (a no-op here — see pcast_varying), which the old
+    # replication checker cannot track; its successor (the VMA type
+    # system) is exactly what the new API replaced it with.
+    kw = {"check_rep": False}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        auto = frozenset(a for a in auto if mesh.shape[a] > 1)
+        if auto:
+            # Refuse loudly rather than feed XLA a program that aborts
+            # the whole process (see SUPPORTS_PARTIAL_AUTO).
+            raise NotImplementedError(
+                "partial-auto shard_map (manual "
+                f"{sorted(axis_names)}, auto {sorted(auto)}) is not "
+                "supported on this jax version; flatten the auto axes "
+                "or upgrade to a jax with jax.shard_map(axis_names=...)"
+            )
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` with a fallback for jaxes
+    that predate it: the distributed client's global state is the same
+    signal the accessor wraps. Safe pre-backend-init on both paths."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    from jax._src import distributed as _dist
+
+    return _dist.global_state.client is not None
+
+
+def pcast_varying(x, axes):
+    """``jax.lax.pcast(x, axes, to="varying")`` where the VMA type
+    system exists; identity elsewhere (pre-VMA jax has no
+    varying/replicated distinction to satisfy, so the cast is a no-op
+    by construction)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axes), to="varying")
+    return x

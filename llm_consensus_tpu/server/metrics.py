@@ -32,6 +32,11 @@ __all__ = [
     "REGISTRY",
     "LATENCY_BUCKETS",
     "THROUGHPUT_BUCKETS",
+    "PREFIX_PAGES_SHARED",
+    "PREFIX_PAGES_COPIED",
+    "PREFIX_LOOKUPS",
+    "PREFIX_HITS",
+    "PREFILL_STALL_SECONDS",
 ]
 
 # Seconds: spans ~1 ms .. 2 min, the TTFT / request-latency range of a
@@ -302,3 +307,42 @@ class MetricsRegistry:
 
 #: The process-wide default registry (scrape target of ``GET /metrics``).
 REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Canonical serving-gateway families (PR 2: shared-prefix paged serving).
+# Defined HERE — not at their instrumentation sites — so the canonical
+# scrape surface is enumerable in one place; the continuous batcher
+# imports and feeds them, and they ride REGISTRY into ``GET /metrics``.
+# ---------------------------------------------------------------------------
+
+#: Pages mapped into an admission's table from the prefix registry
+#: instead of being re-prefilled (each one is page_size tokens of
+#: prompt FLOPs the chip never re-spends).
+PREFIX_PAGES_SHARED = REGISTRY.counter(
+    "gateway_prefix_pages_shared",
+    "KV pages mapped from the shared-prefix registry at admission",
+)
+#: Boundary pages copied (copy-on-write) instead of recomputed.
+PREFIX_PAGES_COPIED = REGISTRY.counter(
+    "gateway_prefix_pages_copied",
+    "Partially-shared boundary pages copied at admission (CoW)",
+)
+#: Prefix-registry hit rate = hits / lookups.
+PREFIX_LOOKUPS = REGISTRY.counter(
+    "gateway_prefix_lookups_total",
+    "Prefix-registry lookups (one per continuous-batcher admission)",
+)
+PREFIX_HITS = REGISTRY.counter(
+    "gateway_prefix_hits_total",
+    "Prefix-registry lookups that mapped or copied at least one page",
+)
+#: How long each prefill work unit kept the decode loop waiting. Under
+#: chunked prefill this is bounded by one chunk's compute; the legacy
+#: blocking path records the WHOLE prompt prefill here — the stall the
+#: chunked scheduler exists to remove.
+PREFILL_STALL_SECONDS = REGISTRY.histogram(
+    "gateway_prefill_stall_seconds",
+    "Decode-loop stall per prefill work unit (chunk or blocking prefill)",
+    buckets=LATENCY_BUCKETS,
+)

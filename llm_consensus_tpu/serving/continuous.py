@@ -9,17 +9,38 @@ style, re-founded on XLA's compile-once constraint:
   paged cache (:mod:`llm_consensus_tpu.models.paged_cache`): shapes never
   change, so the hot loop never recompiles. Admission/retirement mutate
   page tables and lengths — data, not shapes.
-- Prefill runs per-admission on bucketed shapes (compiles once per
-  bucket) and scatters K/V into the sequence's pages.
-- A host thread drives: admit waiting requests into free slots, run one
-  decode step for all slots, sample, retire EOS/length-capped slots,
-  resolve futures. Inactive slots decode into the reserved NULL page and
-  their outputs are discarded (the cost of a dead slot is one row of an
-  already-batched matmul — negligible next to recompilation or bubbles).
+- **Chunked prefill interleaved with decode** (PR 2): prompts prefill in
+  fixed-size chunks scheduled as work units BETWEEN decode steps
+  (compile-once per (chunk, prompt-bucket) pair, paged K/V scatter per
+  chunk — :func:`llm_consensus_tpu.models.transformer.prefill_chunk_paged`),
+  so running slots keep decoding while new prompts fill. A mid-prefill
+  sequence's device table row stays NULL (the decode program never sees
+  it); the chunk program writes through an explicit host-side table.
+  ``prefill_chunk=0`` restores the legacy blocking per-admission dense
+  prefill (the parity baseline).
+- **Copy-on-write shared prefixes**: admission hashes the prompt's
+  page-aligned prefix into a per-shard
+  :class:`~llm_consensus_tpu.models.paged_cache.PrefixRegistry`; full
+  pages of an already-resident prefix are refcount-mapped into the new
+  sequence's table instead of re-prefilled (the consensus panel's N
+  personas over one question prefill the common header ONCE), and a
+  partially-matching boundary page is copied
+  (:func:`~llm_consensus_tpu.models.paged_cache.copy_page`), never
+  shared — decode writes land only in private pages. Registration
+  happens at admission, gated by per-page readiness flags, so a burst
+  of same-prefix requests dedups against the first request's in-flight
+  prefill instead of racing it.
+- A host thread drives: admit waiting requests into free slots, run at
+  most one prefill chunk, run one decode step for all slots, sample,
+  retire EOS/length-capped slots, resolve futures. Inactive slots decode
+  into the reserved NULL page and their outputs are discarded (the cost
+  of a dead slot is one row of an already-batched matmul — negligible
+  next to recompilation or bubbles).
 
 Pages for the whole request (prompt + max_new_tokens) are reserved at
 admission; requests wait while the pool is exhausted (no mid-flight
-growth/preemption in v1 — simpler, and cannot deadlock).
+growth/preemption in v1 — simpler, and cannot deadlock; prefix-registry
+pages held by nobody else are evicted on demand first).
 
 The reference processes requests strictly one-question-at-a-time with
 unbounded per-call HTTP concurrency (``src/main.rs:101,156,182``); this
@@ -30,9 +51,11 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from concurrent.futures import Future
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -55,11 +78,35 @@ from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.models.paged_cache import (
     NULL_PAGE,
     PagedKVCache,
+    PagePool,
+    PrefixRegistry,
     assign_pages,
+    copy_page,
+    install_seq,
     release_seq,
     write_prefill_kv,
 )
-from llm_consensus_tpu.models.transformer import decode_step_paged, prefill
+from llm_consensus_tpu.models.transformer import (
+    decode_step_paged,
+    prefill,
+    prefill_chunk_paged,
+    unembed_one,
+)
+from llm_consensus_tpu.server.metrics import (
+    PREFILL_STALL_SECONDS as _M_PREFILL_STALL,
+)
+from llm_consensus_tpu.server.metrics import (
+    PREFIX_HITS as _M_PREFIX_HITS,
+)
+from llm_consensus_tpu.server.metrics import (
+    PREFIX_LOOKUPS as _M_PREFIX_LOOKUPS,
+)
+from llm_consensus_tpu.server.metrics import (
+    PREFIX_PAGES_COPIED as _M_PREFIX_COPIED,
+)
+from llm_consensus_tpu.server.metrics import (
+    PREFIX_PAGES_SHARED as _M_PREFIX_SHARED,
+)
 from llm_consensus_tpu.server.metrics import REGISTRY as _REG
 
 log = logging.getLogger(__name__)
@@ -118,6 +165,16 @@ class ContinuousConfig:
     # tested). Default 1 = per-token retirement/admission, the right
     # latency behavior on a locally-attached chip.
     steps_per_sync: int = 1
+    # Prefill-chunk width (tokens). > 0: prompts prefill in chunks of
+    # min(prefill_chunk, prompt's seq bucket) scheduled BETWEEN decode
+    # steps — decode stalls per admission are bounded by one chunk's
+    # compute instead of the whole prompt. 0: legacy blocking dense
+    # prefill at admission (parity baseline; disables prefix sharing).
+    prefill_chunk: int = 64
+    # Map page-aligned shared prompt prefixes out of the PrefixRegistry
+    # instead of re-prefilling them. Requires prefill_chunk > 0 (the
+    # chunk program is what can START a prefill mid-prompt).
+    share_prefix: bool = True
 
 
 @dataclass
@@ -154,9 +211,25 @@ class _Request:
 @dataclass
 class _Slot:
     request: _Request
-    pages: list[int]
+    pages: list[int]  # every table page this sequence holds one ref on
     generated: list[int]
     prompt_len: int
+    # "prefill" until the last chunk lands (device table row stays NULL
+    # and the decode loop ignores the row), then "decode".
+    phase: str = "decode"
+    # -- chunked-prefill state (phase == "prefill") --------------------
+    table: np.ndarray | None = None  # host-side table (device sees NULL)
+    next_pos: int = 0  # absolute position of the next chunk's first token
+    chunk: int = 0  # this request's chunk width
+    padded_ids: np.ndarray | None = None  # prompt ids padded to chunk grid
+    s_bucket: int = 0  # prompt's seq bucket (program-family key)
+    # Registry nodes whose page CONTENT this sequence reads (shared
+    # prefix pages written by another in-flight prefill): chunks wait
+    # until every dep is ready.
+    deps: list = field(default_factory=list)
+    # Nodes THIS sequence registered, with the prompt position whose
+    # write completes them: [(node, end_pos)].
+    reg_nodes: list = field(default_factory=list)
 
 
 class ContinuousBatcher:
@@ -210,21 +283,26 @@ class ContinuousBatcher:
         )
         if mesh is not None:
             self.cache = jax.device_put(self.cache, self._pool_sharding)
-        # Host-side page allocator; page 0 is the NULL page. On a mesh,
-        # one free list per data shard: slot s (slots shard in
-        # contiguous blocks) draws only from its own shard's page range,
-        # so a sequence's table always points at shard-local pages.
+        # Host-side refcounted page allocator; page 0 is the NULL page.
+        # On a mesh, one pool (and one prefix registry) per data shard:
+        # slot s (slots shard in contiguous blocks) draws only from its
+        # own shard's page range, so a sequence's table always points at
+        # shard-local pages — and prefix sharing only ever maps pages
+        # within one shard.
         pages_per_shard = c.n_pages // self._dp
         self._shard_of_slot = [
             s * self._dp // c.max_slots for s in range(c.max_slots)
         ]
-        self._free_pages_by_shard = [
-            deque(
+        self._pools = [
+            PagePool(
                 p
                 for p in range(j * pages_per_shard, (j + 1) * pages_per_shard)
                 if p != NULL_PAGE
             )
             for j in range(self._dp)
+        ]
+        self._registries = [
+            PrefixRegistry(pool, c.page_size) for pool in self._pools
         ]
         self._slots: list[_Slot | None] = [None] * c.max_slots
         self._waiting: deque[_Request] = deque()
@@ -240,6 +318,7 @@ class ContinuousBatcher:
         self._completed = 0
         self._generated_tokens = 0
         self._decode_steps = 0
+        self._prefill_chunks = 0
         self._vis_filter = VisibleIdFilter(
             self.tokenizer, skip_ids=(self.tokenizer.eos_id,)
         )
@@ -252,6 +331,13 @@ class ContinuousBatcher:
             self._decode_sample, donate_argnums=(1,), static_argnums=(8,)
         )
         self._jit_prefill = {}
+        self._jit_chunk = {}  # (chunk, s_bucket) -> compiled chunk prefill
+        self._jit_copy_page = jax.jit(copy_page, donate_argnums=(0,))
+        self._jit_unembed = jax.jit(partial(unembed_one, self.cfg))
+        # Round-robin pointer over prefilling slots (fairness when
+        # several prompts fill concurrently).
+        self._prefill_rr = 0
+        self._dense_pending = -1
         self._thread = threading.Thread(
             target=self._run, name="continuous-batcher", daemon=True
         )
@@ -304,7 +390,11 @@ class ContinuousBatcher:
         return toks.T, logps.T, cache
 
     def _prefill_fn(self, s_bucket: int):
-        """Jitted per-bucket: prefill one prompt densely, scatter to pages."""
+        """Jitted per-bucket: prefill one prompt densely, scatter to pages.
+
+        The legacy (``prefill_chunk=0``) admission path — and the parity
+        baseline the chunked path is tested against.
+        """
         if s_bucket not in self._jit_prefill:
 
             def f(params, cache, tokens, length, seq_id):
@@ -319,6 +409,25 @@ class ContinuousBatcher:
 
             self._jit_prefill[s_bucket] = jax.jit(f, donate_argnums=(1,))
         return self._jit_prefill[s_bucket]
+
+    def _chunk_fn(self, chunk: int, s_bucket: int):
+        """Jitted per (chunk, prompt-bucket): one paged prefill chunk.
+
+        Compile-once per chunk bucket: chunk widths come from
+        ``min(config.prefill_chunk, s_bucket)``, so the program family
+        is bounded by the seq-bucket list exactly like dense prefill.
+        The bucket also pins the MoE dispatch path to the choice a
+        one-shot [1, s_bucket] prefill would trace — a chunk below the
+        dense-fallback threshold must not diverge from the dense
+        admission path it is parity-tested against.
+        """
+        key = (chunk, s_bucket)
+        if key not in self._jit_chunk:
+            cfg = self.cfg.moe_pin_for(s_bucket, chunk)
+            self._jit_chunk[key] = jax.jit(
+                partial(prefill_chunk_paged, cfg), donate_argnums=(4,)
+            )
+        return self._jit_chunk[key]
 
     # -- public API -----------------------------------------------------
 
@@ -390,19 +499,36 @@ class ContinuousBatcher:
 
     def stats(self) -> dict:
         """Live serving counters — a consistent snapshot (the worker
-        mutates slots/pages/counters under the same lock)."""
+        mutates slots/pages/counters under the same lock).
+
+        ``free_pages`` counts reclaimable prefix-registry pages (held by
+        nobody but the registry — evicted on demand at admission) as
+        free: they are available capacity, exactly like OS page-cache
+        memory. ``cached_pages`` reports the registry-resident total.
+        """
         with self._lock:
+            regs = self._registries
             return {
-                "active_slots": sum(s is not None for s in self._slots),
+                "active_slots": self._decoding(),
+                "prefilling_slots": sum(
+                    s is not None and s.phase == "prefill"
+                    for s in self._slots
+                ),
                 "max_slots": self.config.max_slots,
                 "waiting": len(self._waiting),
-                "free_pages": sum(
-                    len(d) for d in self._free_pages_by_shard
-                ),
+                "free_pages": sum(p.available for p in self._pools)
+                + sum(r.reclaimable_pages() for r in regs),
                 "total_pages": self.config.n_pages - 1,
+                "cached_pages": sum(r.cached_pages for r in regs),
                 "completed_requests": self._completed,
                 "generated_tokens": self._generated_tokens,
                 "decode_steps": self._decode_steps,
+                "prefill_chunks": self._prefill_chunks,
+                "prefix_lookups": sum(r.lookups for r in regs),
+                "prefix_hits": sum(r.hits for r in regs),
+                "prefix_pages_shared": sum(r.pages_shared for r in regs),
+                "prefix_pages_copied": sum(r.pages_copied for r in regs),
+                "prefix_evictions": sum(r.evictions for r in regs),
             }
 
     def close(self) -> None:
@@ -421,15 +547,45 @@ class ContinuousBatcher:
 
     # -- host loop ------------------------------------------------------
 
+    def _decoding(self) -> int:
+        """Slots currently in the decode phase — THE definition of
+        "active" every surface (gauge, stats, step accounting) shares."""
+        return sum(
+            s is not None and s.phase == "decode" for s in self._slots
+        )
+
     def _bucket(self, n: int) -> int:
         return _next_bucket(n, self.config.seq_buckets)
 
+    def _chunk_width(self, bucket: int) -> int:
+        """Per-request prefill-chunk width: the largest divisor of the
+        prompt bucket <= ``config.prefill_chunk`` (power-of-two buckets
+        keep it at prefill_chunk). Dividing the bucket makes an
+        UNSHARED chunked prefill cover exactly [0, bucket) — the same
+        page footprint as the legacy dense path, so admission
+        feasibility cannot regress."""
+        chunk = min(self.config.prefill_chunk, bucket)
+        while bucket % chunk:
+            chunk -= 1
+        return chunk
+
     def _pages_needed(self, req: _Request) -> int:
+        """Table width in pages for an UNSHARED admission — the
+        admit-ever feasibility bound (a request that only fits via
+        sharing must not wait forever on an empty registry; chunked or
+        dense, the unshared footprint is identical)."""
+        bucket = self._bucket(len(req.prompt_ids))
+        return self._table_pages(bucket, bucket, req)
+
+    def _table_pages(self, bucket: int, prefill_end: int, req: _Request) -> int:
         # + steps_per_sync - 1: a row finishing mid-chunk keeps writing
-        # K/V until the chunk boundary (those tokens are discarded on
-        # host); its pages must absorb the overshoot.
+        # K/V until the decode-chunk boundary (those tokens are
+        # discarded on host); its pages must absorb the overshoot.
+        # prefill_end: last position (+1) the chunked prefill may touch
+        # — a shared-prefix start off the chunk grid can overhang the
+        # bucket by up to chunk-1 positions of masked padding garbage.
         total = (
-            self._bucket(len(req.prompt_ids))
+            max(bucket, prefill_end)
             + req.max_new_tokens
             + max(1, self.config.steps_per_sync)
             - 1
@@ -466,73 +622,319 @@ class ContinuousBatcher:
                         )
                     )
                     continue
-                # A free slot whose data shard still has enough pages
-                # (slot->page affinity keeps sequences shard-local).
-                free_slot = next(
-                    (
-                        i
-                        for i, s in enumerate(self._slots)
-                        if s is None
-                        and len(
-                            self._free_pages_by_shard[self._shard_of_slot[i]]
-                        )
-                        >= n_pages
-                    ),
-                    None,
+                admitted = (
+                    self._admit_chunked(req)
+                    if c.prefill_chunk > 0
+                    else self._admit_dense(req)
                 )
-                if free_slot is None:
+                if not admitted:
                     return  # no slot/pages; retry after retirements
                 self._waiting.popleft()
-                pool = self._free_pages_by_shard[self._shard_of_slot[free_slot]]
-                pages = [pool.popleft() for _ in range(n_pages)]
-
-            s_bucket = self._bucket(len(req.prompt_ids))
-            padded = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
-            padded[0, : len(req.prompt_ids)] = req.prompt_ids
-            table = np.full((c.pages_per_seq,), NULL_PAGE, np.int32)
-            table[: len(pages)] = pages
-            self.cache = assign_pages(
-                self.cache, jnp.int32(free_slot), jnp.asarray(table)
-            )
-            logits, self.cache = self._prefill_fn(s_bucket)(
-                self.params,
-                self.cache,
-                jnp.asarray(padded),
-                jnp.int32(len(req.prompt_ids)),
-                jnp.int32(free_slot),
-            )
-            # First sampled token comes from the prefill logits.
-            key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
-            tok, _ = sample_token_per_request(
-                logits[None],
-                key[None],
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.top_p], jnp.float32),
-                filters_active=(req.top_k != 0 or req.top_p != 1.0),
-            )
-            first = int(tok[0])
-            slot = _Slot(
-                request=req,
-                pages=pages,
-                generated=[first],
-                prompt_len=len(req.prompt_ids),
-            )
-            with self._lock:
-                self._slots[free_slot] = slot
                 _M_WAITING.set(len(self._waiting))
-                _M_ACTIVE.set(sum(s is not None for s in self._slots))
-            self._last_tokens[free_slot] = first
-            self._seeds[free_slot] = req.seed
-            self._counts[free_slot] = 1  # token 0 sampled from prefill
-            self._topks[free_slot] = req.top_k
-            self._topps[free_slot] = req.top_p
+            if c.prefill_chunk == 0:
+                # Legacy path: the dense prefill runs OUTSIDE the lock
+                # (device work must not block submit()).
+                self._dense_prefill_pending()
+
+    # -- admission: chunked + prefix-sharing path ------------------------
+
+    def _admit_chunked(self, req: _Request) -> bool:
+        """Claim a slot + pages for ``req`` and stage it as a prefilling
+        slot (caller holds the lock). Returns False when nothing fits.
+
+        Per candidate slot (= per data shard): match the prompt against
+        the shard's prefix registry, size the table from the true chunk
+        coverage, evict registry-only pages if the free list falls
+        short, allocate, optionally copy the boundary page, and
+        register this prompt's own full pages for successors.
+        """
+        c = self.config
+        ids = req.prompt_ids
+        L = len(ids)
+        bucket = self._bucket(L)
+        chunk = self._chunk_width(bucket)
+
+        # One candidate slot per SHARD: every slot of a shard draws on
+        # the same pool/registry, so retrying a failed plan on a
+        # sibling slot would redo identical match/evict work for the
+        # same answer.
+        seen_shards: set[int] = set()
+        for i in range(c.max_slots):
+            if self._slots[i] is not None:
+                continue
+            shard = self._shard_of_slot[i]
+            if shard in seen_shards:
+                continue
+            seen_shards.add(shard)
+            pool = self._pools[shard]
+            registry = self._registries[shard]
+            # Plan A shares the registered prefix; plan B admits
+            # unshared (exactly the legacy footprint) when the shared
+            # table would overhang the page budget — a prefix start off
+            # the chunk grid pads the final chunk past the bucket, up
+            # to chunk-1 positions.
+            for use_share in (True, False) if c.share_prefix else (False,):
+                match = None
+                shared_pages: list[int] = []
+                start0 = 0
+                boundary = 0
+                if use_share:
+                    # Boundary copies must beat recompute: a whole-page
+                    # device copy for a trivial overlap (every prompt
+                    # shares BOS) is pure overhead.
+                    match = registry.match(
+                        ids, min_boundary=max(2, c.page_size // 4)
+                    )
+                    _M_PREFIX_LOOKUPS.inc()
+                    shared_pages = match.pages
+                    start0 = match.shared_tokens
+                    if match.boundary_page is not None:
+                        boundary = match.boundary_common
+                    if not shared_pages and not boundary:
+                        continue  # registry miss: plan B is identical
+                start = start0 + boundary
+                end = start + -(-(L - start) // chunk) * chunk
+                total = self._table_pages(bucket, end, req)
+                need_new = total - len(shared_pages)
+                # Infeasibility first: evicting cached prefixes to make
+                # room for a plan the NEXT check rejects anyway would
+                # self-destroy the registry this feature depends on.
+                if total > c.pages_per_seq:
+                    for p in shared_pages:
+                        pool.release(p)
+                    continue
+                if pool.available < need_new:
+                    registry.evict(need_new - pool.available)
+                if pool.available < need_new:
+                    # Give the refs back; plan B (or another slot's
+                    # shard, or a later retirement) may fit.
+                    for p in shared_pages:
+                        pool.release(p)
+                    continue
+                if use_share:
+                    registry.record_commit(match, copied=bool(boundary))
+                    _M_PREFIX_HITS.inc()
+                    _M_PREFIX_SHARED.inc(len(shared_pages))
+                new_pages = pool.alloc(need_new)
+                pages = shared_pages + new_pages
+                table = np.full((c.pages_per_seq,), NULL_PAGE, np.int32)
+                table[: len(pages)] = pages
+                if boundary:
+                    # Copy-on-write: the donor's boundary page extends
+                    # our prefix mid-page; copy its content into our
+                    # first private page and resume prefill after the
+                    # common run.
+                    _M_PREFIX_COPIED.inc()
+                    self.cache = self._jit_copy_page(
+                        self.cache,
+                        jnp.int32(match.boundary_page),
+                        jnp.int32(new_pages[0]),
+                    )
+                # Offer our own full prompt pages to successors
+                # (pending until our prefill writes past each page) —
+                # unless sharing is off: a registry nobody consults
+                # must not pin retired requests' pages either.
+                reg_nodes = (
+                    registry.register(ids, pages) if c.share_prefix else []
+                )
+                padded = np.full((end,), self.tokenizer.pad_id, np.int32)
+                padded[:L] = ids
+                deps = [
+                    n
+                    for n in (match.nodes if match else [])
+                    if not n.ready
+                ]
+                self._slots[i] = _Slot(
+                    request=req,
+                    pages=pages,
+                    generated=[],
+                    prompt_len=L,
+                    phase="prefill",
+                    table=table,
+                    next_pos=start,
+                    chunk=chunk,
+                    padded_ids=padded,
+                    s_bucket=bucket,
+                    deps=deps,
+                    reg_nodes=reg_nodes,
+                )
+                return True
+        return False
+
+    def _prefill_step(self) -> bool:
+        """Run ONE prefill chunk for one ready prefilling slot.
+
+        The unit of decode stall under chunked prefill: between any two
+        decode steps at most one of these runs, so admission latency
+        costs running requests one bounded chunk, never a whole prompt.
+        Returns True when a chunk was executed.
+        """
+        c = self.config
+        n = c.max_slots
+        idx = None
+        for off in range(n):
+            i = (self._prefill_rr + off) % n
+            s = self._slots[i]
             if (
-                first == self.tokenizer.eos_id
-                or req.max_new_tokens <= 1
-                or self._hit_stop(slot)
+                s is not None
+                and s.phase == "prefill"
+                and all(node.ready for node in s.deps)
             ):
-                self._retire(free_slot)
+                idx = i
+                break
+        if idx is None:
+            return False
+        self._prefill_rr = (idx + 1) % n
+        slot = self._slots[idx]
+        t0 = time.perf_counter()
+        chunk_ids = slot.padded_ids[slot.next_pos : slot.next_pos + slot.chunk]
+        hidden, self.cache = self._chunk_fn(slot.chunk, slot.s_bucket)(
+            self.params,
+            jnp.asarray(chunk_ids[None]),
+            jnp.asarray(slot.table),
+            jnp.int32(slot.next_pos),
+            self.cache,
+        )
+        written_end = slot.next_pos + slot.chunk
+        done = written_end >= slot.prompt_len
+        if done:
+            # Sample the first token from the last REAL position's
+            # hidden state (a [D] gather + D x V unembed — never a
+            # [C, V] logits buffer per chunk).
+            h = hidden[0, slot.prompt_len - 1 - slot.next_pos]
+            logits = self._jit_unembed(self.params, h)
+            first = self._sample_first(slot.request, logits)
+        # The device work above must COMPLETE before (a) the stall
+        # histogram records it and (b) successors read the pages this
+        # chunk wrote.
+        jax.block_until_ready(self.cache.length)
+        _M_PREFILL_STALL.observe(time.perf_counter() - t0)
+        written_real = min(written_end, slot.prompt_len)
+        for node, end_pos in slot.reg_nodes:
+            if not node.ready and end_pos <= written_real:
+                node.ready = True
+        slot.next_pos = written_end
+        with self._lock:
+            self._prefill_chunks += 1
+        if not done:
+            return True
+        # Final chunk landed: make the row visible to the decode program
+        # (table + true length in one pass) and flip to decoding.
+        self.cache = install_seq(
+            self.cache,
+            jnp.int32(idx),
+            jnp.asarray(slot.table),
+            jnp.int32(slot.prompt_len),
+        )
+        self._activate(idx, slot, first)
+        return True
+
+    def _sample_first(self, req: _Request, logits) -> int:
+        """First generated token, sampled from prefill logits — the
+        same (seed, 0) PRNG draw both admission paths share."""
+        key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
+        tok, _ = sample_token_per_request(
+            logits[None],
+            key[None],
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+            filters_active=(req.top_k != 0 or req.top_p != 1.0),
+        )
+        return int(tok[0])
+
+    def _activate(self, idx: int, slot: _Slot, first: int) -> None:
+        """Flip a slot to decoding with its first sampled token."""
+        req = slot.request
+        slot.generated.append(first)
+        slot.phase = "decode"
+        slot.deps = []
+        with self._lock:
+            _M_ACTIVE.set(self._decoding())
+        self._last_tokens[idx] = first
+        self._seeds[idx] = req.seed
+        self._counts[idx] = 1  # token 0 sampled from prefill
+        self._topks[idx] = req.top_k
+        self._topps[idx] = req.top_p
+        if (
+            first == self.tokenizer.eos_id
+            or req.max_new_tokens <= 1
+            or self._hit_stop(slot)
+        ):
+            self._retire(idx)
+
+    # -- admission: legacy blocking dense-prefill path -------------------
+
+    def _admit_dense(self, req: _Request) -> bool:
+        """Claim a slot + pages (caller holds the lock); the dense
+        prefill itself runs from :meth:`_dense_prefill_pending` outside
+        the lock. Returns False when nothing fits."""
+        c = self.config
+        n_pages = self._pages_needed(req)
+        free_slot = next(
+            (
+                i
+                for i, s in enumerate(self._slots)
+                if s is None
+                and self._pools[self._shard_of_slot[i]].available >= n_pages
+            ),
+            None,
+        )
+        if free_slot is None:
+            # Registry pages are reclaimable capacity even on this path
+            # (a prior chunked-config batcher cannot have populated it —
+            # but evict defensively so the two paths agree on capacity).
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    shard = self._shard_of_slot[i]
+                    self._registries[shard].evict(
+                        n_pages - self._pools[shard].available
+                    )
+                    if self._pools[shard].available >= n_pages:
+                        free_slot = i
+                        break
+            if free_slot is None:
+                return False
+        pool = self._pools[self._shard_of_slot[free_slot]]
+        pages = pool.alloc(n_pages)
+        self._slots[free_slot] = _Slot(
+            request=req,
+            pages=pages,
+            generated=[],
+            prompt_len=len(req.prompt_ids),
+            phase="prefill",  # not decodable until the prefill lands
+        )
+        self._dense_pending = free_slot
+        return True
+
+    def _dense_prefill_pending(self) -> None:
+        """Blocking dense prefill for the slot staged by _admit_dense."""
+        c = self.config
+        idx = self._dense_pending
+        slot = self._slots[idx]
+        req = slot.request
+        t0 = time.perf_counter()
+        s_bucket = self._bucket(len(req.prompt_ids))
+        padded = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        padded[0, : len(req.prompt_ids)] = req.prompt_ids
+        table = np.full((c.pages_per_seq,), NULL_PAGE, np.int32)
+        table[: len(slot.pages)] = slot.pages
+        self.cache = assign_pages(
+            self.cache, jnp.int32(idx), jnp.asarray(table)
+        )
+        logits, self.cache = self._prefill_fn(s_bucket)(
+            self.params,
+            self.cache,
+            jnp.asarray(padded),
+            jnp.int32(len(req.prompt_ids)),
+            jnp.int32(idx),
+        )
+        first = self._sample_first(req, logits)
+        jax.block_until_ready(self.cache.length)
+        # The whole-prompt stall this path pays per admission — the
+        # number the chunked scheduler bounds to one chunk.
+        _M_PREFILL_STALL.observe(time.perf_counter() - t0)
+        self._activate(idx, slot, first)
 
     def _decoded_text(self, slot: _Slot) -> str:
         ids = [t for t in slot.generated if t != self.tokenizer.eos_id]
@@ -561,14 +963,18 @@ class ContinuousBatcher:
         slot = self._slots[idx]
         assert slot is not None
         self.cache = release_seq(self.cache, jnp.int32(idx))
+        pool = self._pools[self._shard_of_slot[idx]]
         with self._lock:
-            self._free_pages_by_shard[self._shard_of_slot[idx]].extend(
-                slot.pages
-            )
+            # Refcounted release: private pages return to the free
+            # list; prefix-shared pages stay resident for their other
+            # readers (and the registry's own hold keeps a retired
+            # donor's prefix warm for future admissions).
+            for p in slot.pages:
+                pool.release(p)
             self._slots[idx] = None
             self._completed += 1
             self._generated_tokens += len(slot.generated)
-            _M_ACTIVE.set(sum(s is not None for s in self._slots))
+            _M_ACTIVE.set(self._decoding())
         _M_COMPLETED.inc()
         _M_TOKENS.inc(len(slot.generated))
         text = self._decoded_text(slot)
@@ -590,10 +996,11 @@ class ContinuousBatcher:
         c = self.config
         temps = np.zeros((c.max_slots,), np.float32)
         for i, slot in enumerate(self._slots):
-            if slot is not None:
+            if slot is not None and slot.phase == "decode":
                 temps[i] = slot.request.temperature
         filters_active = any(
             s is not None
+            and s.phase == "decode"
             and (s.request.top_k != 0 or s.request.top_p != 1.0)
             for s in self._slots
         )
@@ -617,13 +1024,13 @@ class ContinuousBatcher:
         k = max(1, self.config.steps_per_sync)
         with self._lock:
             self._decode_steps += k
-            active = sum(s is not None for s in self._slots)
+            active = self._decoding()
         _M_STEPS.inc(k)
         if active:
             _M_OCCUPANCY.observe(active)
         next_np = np.asarray(next_tok)  # [slots, k] — THE host sync
         for i, slot in enumerate(self._slots):
-            if slot is None:
+            if slot is None or slot.phase != "decode":
                 continue
             # Device streams advanced k for every row; host counters
             # must track the DEVICE stream, not the kept tokens, so a
@@ -649,9 +1056,16 @@ class ContinuousBatcher:
     def _run(self) -> None:
         while not self._stop.is_set():
             self._admit()
-            if any(s is not None for s in self._slots):
+            progress = False
+            # At most ONE prefill chunk between decode steps: running
+            # slots pay a bounded, chunk-sized stall per admission
+            # instead of a whole prompt's prefill.
+            if self.config.prefill_chunk > 0 and self._prefill_step():
+                progress = True
+            if self._decoding():
                 self._step()
-            else:
+                progress = True
+            if not progress:
                 self._work.wait(timeout=0.1)
                 self._work.clear()
 

@@ -133,8 +133,16 @@ def stop_tail_window(tokenizer, stops: Iterable[str], slack: int = 8) -> int:
     stops = list(stops)
     if not stops:
         return 0
+    # surrogateescape: a stop carved from decoded model output can carry
+    # lone surrogates standing in for invalid bytes (the ByteTokenizer's
+    # reversible decode); each encodes back to exactly the one byte it
+    # stands for, so the byte-length bound stays exact — strict UTF-8
+    # would raise on text the engine itself produced.
     span = max(
-        max(len(s.encode("utf-8")), len(tokenizer.encode(s, add_bos=False)))
+        max(
+            len(s.encode("utf-8", errors="surrogateescape")),
+            len(tokenizer.encode(s, add_bos=False)),
+        )
         for s in stops
     )
     return span + slack

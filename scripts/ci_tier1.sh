@@ -4,7 +4,16 @@
 # slow'); keep the two in sync by editing ROADMAP.md first. Exit code is
 # pytest's; DOTS_PASSED echoes the per-test pass count the growth driver
 # compares against the seed.
+#
+#   --smoke   fast paged-serving slice (~1 min) for iterating on the
+#             continuous batcher / page-table stack without the full
+#             ~15 min suite.
 cd "$(dirname "$0")/.." || exit 1
+if [ "$1" = "--smoke" ]; then
+  exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_paged_cache.py tests/test_server.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly
+fi
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 1080 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

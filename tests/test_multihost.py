@@ -65,8 +65,10 @@ def test_initialize_raises_on_explicit_config_failure(monkeypatch):
         initialize_distributed,
     )
 
+    from llm_consensus_tpu.parallel import compat
+
     monkeypatch.setattr(
-        jax.distributed, "is_initialized", lambda: False
+        compat, "distributed_is_initialized", lambda: False
     )
 
     def boom(**kw):
