@@ -209,6 +209,23 @@ def main() -> int:
         "(ContinuousConfig.prefill_chunk; 0 = legacy blocking dense "
         "prefill at admission)",
     )
+    p.add_argument(
+        "--serve-prefix-attention",
+        action="store_true",
+        help="serving A/B leg: the panel-shaped shared-prefix burst "
+        "served twice — group-aware decode attention ON (shared prefix "
+        "KV read once per group per step) vs OFF (the row kernel) — "
+        "reporting tok/s for both, shared-KV bytes saved, and that the "
+        "generated text is unchanged",
+    )
+    p.add_argument(
+        "--fanout-prefix-ab",
+        action="store_true",
+        help="engine-level A/B leg: the N-candidate shared-prefill "
+        "fan-out decoded with the two-phase shared-prefix kernel ON "
+        "(prefix KV read once per step for the whole batch) vs OFF, "
+        "reporting candidate-tok/s for both and token parity",
+    )
     args = p.parse_args()
 
     if args.cpu:
@@ -342,6 +359,10 @@ def main() -> int:
 
     if args.draft:
         return _bench_speculative(args, cfg, params, tokens, lengths)
+    if args.serve_prefix_attention:
+        return _bench_serving_prefix_ab(args, cfg, params)
+    if args.fanout_prefix_ab:
+        return _bench_fanout_prefix_ab(args, cfg, params, tokens, lengths)
     if args.serve or args.serve_shared_prefix:
         return _bench_serving(args, cfg, params)
 
@@ -537,6 +558,219 @@ def _bench_speculative(args, cfg, params, tokens, lengths) -> int:
         )
     )
     return 0
+
+
+def _bench_serving_prefix_ab(args, cfg, params) -> int:
+    """Group-aware decode attention A/B on the panel-shaped burst.
+
+    Serves the same shared-prefix burst twice through ContinuousBatcher
+    — ``prefix_attention`` on (shared prefix pages read once per group
+    per decode step) vs off (the ungrouped row kernel) — and reports
+    generated tok/s for both, the shared-KV bytes the grouped program
+    skipped, the largest group size, and whether the generated text is
+    byte-identical (the acceptance contract: the kernel is a pure
+    bandwidth optimization).
+    """
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    if not cfg.use_pallas:
+        if args.tiny or args.model == "test-tiny":
+            # The grouped kernel requires the Pallas paged path; on a
+            # CPU tiny run, engage it in interpret mode so the leg
+            # still demonstrates the dedup end to end.
+            cfg = cfg.with_(use_pallas=True)
+            print(
+                "[bench] tiny CPU run: Pallas interpret mode forced so "
+                "the grouped kernel engages",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "[bench] --serve-prefix-attention needs the Pallas "
+                "paged decode path (single TPU chip, or --tiny --cpu "
+                "for interpret mode)",
+                file=sys.stderr,
+            )
+            return 2
+
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    # Header sized to cover >= 2 FULL pages even at small --prompt-len:
+    # full pages are the sharing unit (a sub-page prefix maps nothing),
+    # and the bucket list is sized off the real prompt so truncation
+    # can never silently misalign the shared prefix across requests.
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    header = f"Panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    prompts = [
+        header + f"Q{i}: item {i * 37 % 101}?"
+        for i in range(args.serve_requests)
+    ]
+    longest = max(len(p) for p in prompts) + 1
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    pages_per_seq = -(
+        -(buckets[-1] + args.new_tokens + args.serve_chunk - 1) // pg
+    )
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2
+    prefill_chunk = args.serve_prefill_chunk or 64
+
+    def run(prefix_attention: bool):
+        batcher = ContinuousBatcher(
+            cfg,
+            params,
+            config=ContinuousConfig(
+                max_slots=args.serve_slots,
+                page_size=pg,
+                n_pages=n_pages,
+                pages_per_seq=pages_per_seq,
+                max_new_tokens=args.new_tokens,
+                seq_buckets=tuple(buckets),
+                steps_per_sync=args.serve_chunk,
+                prefill_chunk=prefill_chunk,
+                share_prefix=True,
+                prefix_attention=prefix_attention,
+            ),
+        )
+        try:
+            # Warmup compiles the prefill/chunk/decode programs on a
+            # prompt outside the burst set (replay hazard, see main()).
+            batcher.submit(
+                f"warmup {salt} " + "ctx " * (args.prompt_len // 5),
+                max_new_tokens=args.new_tokens,
+            ).result(timeout=600)
+            before = batcher.stats()
+            t0 = time.perf_counter()
+            futs = [
+                batcher.submit(p, max_new_tokens=args.new_tokens)
+                for p in prompts
+            ]
+            results = [f.result(timeout=600) for f in futs]
+            wall = time.perf_counter() - t0
+            after = batcher.stats()
+        finally:
+            batcher.close()
+        toks = sum(r.num_tokens for r in results)
+        saved = (
+            after["shared_kv_bytes_saved"] - before["shared_kv_bytes_saved"]
+        )
+        return [r.text for r in results], toks / wall, saved, after
+
+    texts_on, tps_on, saved_on, stats_on = run(True)
+    texts_off, tps_off, saved_off, _ = run(False)
+    unchanged = texts_on == texts_off
+    print(
+        json.dumps(
+            {
+                "metric": f"serving tok/s, grouped prefix attention "
+                f"({cfg.name}, {args.serve_requests} reqs, "
+                f"slots={args.serve_slots}, decode {args.new_tokens} @ "
+                f"~{args.prompt_len} shared prompt, chunk="
+                f"{args.serve_chunk}, kernel OFF {tps_off:.0f} tok/s, "
+                f"shared-KV saved {saved_on} B "
+                f"[{saved_on / 2**20:.2f} MiB] (off leg {saved_off} B), "
+                f"peak group {stats_on['decode_group_peak']}, "
+                f"text unchanged={unchanged})",
+                "value": round(tps_on, 2),
+                "unit": "tokens/sec",
+                "vs_baseline": round(tps_on / max(tps_off, 1e-9), 4),
+            }
+        )
+    )
+    if not unchanged:
+        print(
+            "[bench] GENERATED TEXT DIVERGED between grouped and "
+            "ungrouped attention — kernel regression",
+            file=sys.stderr,
+        )
+        return 1
+    return 0 if saved_on > 0 else 1
+
+
+def _bench_fanout_prefix_ab(args, cfg, params, tokens, lengths) -> int:
+    """Engine N-fanout A/B: shared-prefill decode with the two-phase
+    shared-prefix kernel on vs off (same program shapes otherwise).
+
+    The group here is the WHOLE batch — N candidates over one prompt —
+    so the prefix half of the decode roofline drops from N*S to S; the
+    measured delta is that bandwidth back as throughput. Greedy-free
+    fixed-work legs (eos -1), host-fetch synced like the main bench.
+    """
+    from llm_consensus_tpu.engine.generate import generate
+
+    if not cfg.use_pallas and (args.tiny or args.model == "test-tiny"):
+        # Interpret mode on CPU so the two-phase kernel engages at all
+        # (the A/B is meaningless if both legs run the jnp path).
+        cfg = cfg.with_(use_pallas=True)
+        print(
+            "[bench] tiny CPU run: Pallas interpret mode forced so the "
+            "shared-prefix kernel engages",
+            file=sys.stderr,
+        )
+    b = tokens.shape[0]
+    # Greedy legs: the parity check compares argmax streams, where the
+    # two-phase merge's ~1e-6 reassociation noise cannot flip a token
+    # short of an exact logit tie (sampled streams would be noisier).
+    temps = jnp.zeros((b,), jnp.float32)
+    salt = int(time.time() * 1e6) % 29989
+    key = jax.random.PRNGKey(salt)
+
+    def make_run(prefix_attention: bool):
+        def run(i):
+            toks = tokens.at[0, 0].set(1 + (salt + i) % 30000)
+            return generate(
+                cfg, params, toks, lengths,
+                jax.random.fold_in(key, i), temps,
+                max_new_tokens=args.new_tokens,
+                eos_id=-1,
+                shared_prefill=True,
+                kv_quant=args.kv_quant == "int8",
+                shared_prefix_attention=prefix_attention,
+            )
+
+        return run
+
+    import numpy as _np
+
+    legs = {}
+    outs = {}
+    for name, on in (("on", True), ("off", False)):
+        run = make_run(on)
+        t0 = time.perf_counter()
+        _np.asarray(run(0).tokens)  # compile + first run
+        print(
+            f"[bench] fanout-prefix {name}: compile+first "
+            f"{time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            outs[name] = _np.asarray(run(i + 1).tokens)
+        wall = (time.perf_counter() - t0) / args.iters
+        legs[name] = b * args.new_tokens / wall
+    parity = bool(_np.array_equal(outs["on"], outs["off"]))
+    n_chips = jax.device_count()
+    print(
+        json.dumps(
+            {
+                "metric": f"candidate-tokens/sec/chip, shared-prefix "
+                f"decode kernel ({cfg.name}, N={b}, decode "
+                f"{args.new_tokens} @ prompt {tokens.shape[1]}, "
+                f"kv={args.kv_quant}, kernel OFF "
+                f"{legs['off'] / n_chips:.0f} tok/s/chip, "
+                f"tokens equal={parity})",
+                "value": round(legs["on"] / n_chips, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(legs["on"] / max(legs["off"], 1e-9), 4),
+            }
+        )
+    )
+    return 0 if parity else 1
 
 
 def _bench_serving(args, cfg, params) -> int:

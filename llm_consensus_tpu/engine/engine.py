@@ -49,12 +49,27 @@ def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
 
 
 def _kv_cache_bytes(
-    cfg: ModelConfig, batch: int, cache_len: int, quant: bool, slack: int = 0
+    cfg: ModelConfig,
+    batch: int,
+    cache_len: int,
+    quant: bool,
+    slack: int = 0,
+    shared_len: int = 0,
 ) -> int:
     """KV-cache bytes for a generate call — the ONE copy of the cache
     capacity formula (memory_estimate and plan_memory both call it, so
-    a cache-layout change cannot silently drift between them)."""
-    slots = cfg.n_layers * batch * (cache_len + slack) * cfg.n_kv_heads
+    a cache-layout change cannot silently drift between them).
+
+    ``shared_len``: prompt-prefix tokens STORED ONCE for the whole
+    batch instead of once per row — the paged serving path's CoW page
+    sharing (PR 2) dedups an N-fanout's common prompt in memory, so a
+    post-PR-2 footprint prediction must count prefix + N*suffix, not
+    N*(prefix + suffix). 0 (the default) models the dense per-row
+    cache, which still duplicates.
+    """
+    shared_len = max(0, min(shared_len, cache_len))
+    tokens = batch * (cache_len + slack) - (batch - 1) * shared_len
+    slots = cfg.n_layers * tokens * cfg.n_kv_heads
     if quant:
         # int8 k+v + one f32 scale each per (slot, head)
         return slots * (2 * cfg.head_dim + 2 * 4)
@@ -603,6 +618,7 @@ class InferenceEngine:
         prompt_len: int = 128,
         new_tokens: int | None = None,
         hbm_bytes: int | None = None,
+        shared_prefix_len: int = 0,
     ) -> dict:
         """HBM budget estimate for a generate call at the given shapes.
 
@@ -616,6 +632,14 @@ class InferenceEngine:
         over data; cache/logits over data x model per ``cache_pspecs``).
         Capacity planning for the N-way fan-out: "does N=64 at 4k
         context fit?" without OOMing a real chip to find out.
+
+        ``shared_prefix_len``: prompt-prefix tokens shared by every
+        candidate and STORED ONCE — the paged serving path's CoW page
+        sharing (PR 2/3), where an N-fanout's KV footprint is
+        prefix + N*suffix. The default 0 models the engine's dense
+        per-row cache, which duplicates the prefix (the pre-PR-2
+        worst case; capped at the bucketed prompt length since decode
+        suffixes are never shared).
         """
         from llm_consensus_tpu.ops.quant import quantized_bytes
 
@@ -629,7 +653,10 @@ class InferenceEngine:
         b = _next_bucket(n_candidates, self.config.batch_buckets)
         cache_len = s + mnt
 
-        kv = _kv_cache_bytes(cfg, b, cache_len, self.config.kv_quant)
+        kv = _kv_cache_bytes(
+            cfg, b, cache_len, self.config.kv_quant,
+            shared_len=min(shared_prefix_len, s),
+        )
         if self.draft is not None:
             d_cfg, d_params = self.draft
             # Speculative decoding holds bf16 target + draft caches.
@@ -1328,6 +1355,7 @@ def plan_memory(
     hbm_bytes: int | None = None,
     seq_buckets: tuple[int, ...] | None = None,
     batch_buckets: tuple[int, ...] | None = None,
+    shared_prefix_len: int = 0,
 ) -> dict:
     """Config-only HBM plan — no weights are ever allocated.
 
@@ -1344,7 +1372,9 @@ def plan_memory(
     allocates, not the raw request. Pass ``buckets=()``-style overrides
     to mirror a custom engine config. ``mesh_shape`` (e.g.
     ``{"data": 4, "model": 2}``) divides each term by the axes it
-    shards over.
+    shards over. ``shared_prefix_len``: prompt tokens stored once for
+    the whole fan-out (the paged serving path's prefix sharing) — see
+    :meth:`InferenceEngine.memory_estimate`.
     """
     from llm_consensus_tpu.models.transformer import init_params
     from llm_consensus_tpu.ops.quant import quantize_params, quantized_bytes
@@ -1363,7 +1393,9 @@ def plan_memory(
     b = _next_bucket(n_candidates, bb)
     mnt = max(1, min(new_tokens, cfg.max_seq_len - s))
     cache_len = s + mnt
-    kv = _kv_cache_bytes(cfg, b, cache_len, kv_quant)
+    kv = _kv_cache_bytes(
+        cfg, b, cache_len, kv_quant, shared_len=min(shared_prefix_len, s)
+    )
     logits = _logits_bytes(cfg, b)
 
     shape = dict(mesh_shape or {})

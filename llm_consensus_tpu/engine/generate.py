@@ -75,6 +75,7 @@ class GenerateOutput:
         "mesh",  # hashable; trace-time constant for the ring routing
         "prefill_chunk",
         "stop_ids",
+        "shared_prefix_attention",
     ),
 )
 def generate(
@@ -95,12 +96,22 @@ def generate(
     mesh=None,
     prefill_chunk: int = 0,
     stop_ids: tuple[int, ...] = (),
+    shared_prefix_attention: bool = True,
 ) -> GenerateOutput:
     """Generate up to ``max_new_tokens`` for a batch of right-padded prompts.
 
     tokens: [B, S] int32 right-padded prompts; lengths: [B] true lengths;
     key: PRNG key (folded per decode step; rows draw independent samples
     from the batched categorical); temperature: [B] per-row (0 = greedy).
+
+    ``shared_prefix_attention`` (static, default on): under
+    ``shared_prefill`` every row's cache holds the SAME prompt K/V in
+    slots [0, prompt_len) — the decode loop then reads that region once
+    per step for the whole batch through the two-phase shared-prefix
+    kernels (S + N*suffix HBM traffic instead of N*S) with an exact
+    log-sum-exp merge. Off = the ungrouped row kernels (the A/B
+    baseline; outputs identical). Only the single-chip Pallas
+    non-windowed decode paths engage either way.
     """
     b, s = tokens.shape
     if cache_len is None:
@@ -132,6 +143,12 @@ def generate(
         max_new_tokens=max_new_tokens,
         uniform_write=shared_prefill,
         stop_ids=stop_ids,
+        # All rows share the prompt's K/V in [0, lengths[0]) — read it
+        # once per step for the whole fan-out (N is where the KV term
+        # of the decode roofline lives).
+        shared_prefix_len=(
+            lengths[0] if shared_prefill and shared_prefix_attention else None
+        ),
     )
 
 
@@ -231,6 +248,7 @@ def _decode_loop(
     max_new_tokens: int,
     uniform_write: bool,
     stop_ids: tuple[int, ...] = (),
+    shared_prefix_len=None,
 ) -> GenerateOutput:
     """The shared lax.scan decode loop, from first-token logits onward.
 
@@ -239,6 +257,11 @@ def _decode_loop(
     token is still emitted/counted, like EOS). Used by the engine for
     single-token stop sequences so finished rows stop burning steps'
     logprob accumulation and the host can trim deterministically.
+
+    ``shared_prefix_len`` (traced scalar or None): the length of the
+    identical-across-rows cache prefix — threaded into every decode
+    step so the shared-prefix kernels read the common KV once per step
+    (see :func:`~llm_consensus_tpu.models.transformer.decode_step`).
     """
     b = logits.shape[0]
     _is_terminal = _terminal_matcher(eos_id, stop_ids)
@@ -255,7 +278,8 @@ def _decode_loop(
         # (all start equal, all advance by one each step), so the cache
         # write can be a slice update instead of a scatter.
         logits, cache = decode_step(
-            cfg, params, tok[:, None], cache, uniform_write=uniform_write
+            cfg, params, tok[:, None], cache, uniform_write=uniform_write,
+            shared_prefix_len=shared_prefix_len,
         )
         step_key = jax.random.fold_in(key, i + 1)
         next_tok, lp = sample_token(logits, step_key, temperature, sampler)
@@ -300,6 +324,7 @@ def _decode_loop(
         "shared_suffix",
         "kv_quant",
         "moe_suffix_dense",
+        "shared_prefix_attention",
     ),
 )
 def generate_from_prefix(
@@ -322,6 +347,7 @@ def generate_from_prefix(
     shared_suffix: bool = False,
     kv_quant: bool = False,
     moe_suffix_dense: bool | None = None,
+    shared_prefix_attention: bool = True,
 ) -> GenerateOutput:
     """Generate continuing from a prefilled shared prompt prefix.
 
@@ -408,6 +434,14 @@ def generate_from_prefix(
         # decode cache writes compile to slice updates, not scatters.
         uniform_write=shared_suffix,
         stop_ids=stop_ids,
+        # Under shared_suffix, prefix AND suffix chunk are identical
+        # across rows: the whole prefilled region [0, plen + suffix)
+        # reads once per decode step.
+        shared_prefix_len=(
+            jnp.asarray(prefix_len, jnp.int32) + lengths[0]
+            if shared_suffix and shared_prefix_attention
+            else None
+        ),
     )
 
 

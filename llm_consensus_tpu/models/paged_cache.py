@@ -44,6 +44,7 @@ from typing import Iterable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from llm_consensus_tpu.models.configs import ModelConfig
 
@@ -493,3 +494,148 @@ class PrefixRegistry:
             ):
                 heapq.heappush(heap, (parent.last_used, id(parent), parent))
         return freed
+
+
+# ---------------------------------------------------------------------------
+# Decode groups: which resident sequences share a prefix page run
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DecodeGroupArrays:
+    """Device-side group metadata for the group-aware decode kernel
+    (:func:`llm_consensus_tpu.ops.pallas.paged_decode_attention_grouped`).
+
+    All int32. ``group_id`` [max_seqs]: group per row, -1 ungrouped;
+    ``group_rep`` [Gm]: a member row whose page table holds the group's
+    shared run; ``group_pages`` [Gm]: pages in that run (0 = padding
+    slot); ``shared_start`` [max_seqs]: tokens the shared phase covers
+    per row (page-aligned; 0 for ungrouped rows).
+    """
+
+    group_id: jnp.ndarray
+    group_rep: jnp.ndarray
+    group_pages: jnp.ndarray
+    shared_start: jnp.ndarray
+
+
+class GroupTracker:
+    """Host-side decode-group metadata over shared prefix page runs.
+
+    Every decoding sequence registers its PREFIX RUN — the page ids
+    covering its prompt's full pages, in table order. Two runs that
+    begin with the same page ids hold the same tokens by construction
+    (pages are shared exclusively through the :class:`PrefixRegistry`'s
+    refcount mapping, and decode never writes into a full prompt page),
+    so sequences are grouped by the longest common prefix of their
+    runs: a consensus panel's donor (which allocated and REGISTERED the
+    header pages) and its N-1 mappers land in one group even though the
+    donor's own run extends past the header. The grouped kernel then
+    reads each group's common run once per step; members' remaining
+    pages are their suffix.
+
+    Membership updates incrementally at activation/retirement (O(1)
+    dict ops); the device arrays rebuild lazily on the next
+    :meth:`arrays` after a change. Grouping is one level (common prefix
+    per first-page bucket, not a full trie): nested sharing patterns
+    degrade to the bucket-wide common run, never to wrong output.
+    Single-member buckets emit nothing (reading a run once for one
+    reader is what the ungrouped kernel already does) and only the
+    ``max_groups`` largest groups emit — overflow rows simply stay
+    ungrouped, correct either way.
+
+    Not thread-safe: the continuous batcher's worker owns it, exactly
+    like the pools/registries.
+    """
+
+    def __init__(
+        self, max_seqs: int, page_size: int, max_groups: int | None = None
+    ):
+        self.max_seqs = max_seqs
+        self.page_size = page_size
+        self.max_groups = max_groups or max(1, max_seqs // 2)
+        self._run_of_seq: dict[int, tuple[int, ...]] = {}
+        self._dirty = True
+        self._cached: DecodeGroupArrays | None = None
+        # Step stats for the arrays most recently built: tokens of KV
+        # the grouped read dedups per decode step, and the largest
+        # group's member count (the observability satellites).
+        # ``peak_group`` is the lifetime high-water mark — the number a
+        # post-burst stats() read still sees after every member retired.
+        self.saved_tokens_per_step = 0
+        self.largest_group = 0
+        self.peak_group = 0
+
+    def add(self, seq_id: int, prefix_run: Sequence[int]) -> None:
+        """Register a decoding sequence's prompt prefix page run (no-op
+        for an empty run — a sub-page prompt stays ungrouped)."""
+        run = tuple(int(p) for p in prefix_run)
+        self.remove(seq_id)
+        if not run:
+            return
+        self._run_of_seq[seq_id] = run
+        self._dirty = True
+
+    def remove(self, seq_id: int) -> None:
+        if self._run_of_seq.pop(seq_id, None) is not None:
+            self._dirty = True
+
+    @staticmethod
+    def _common_prefix(runs: list[tuple[int, ...]]) -> int:
+        k = 0
+        for pages in zip(*runs):
+            if any(p != pages[0] for p in pages[1:]):
+                break
+            k += 1
+        return k
+
+    def arrays(self) -> DecodeGroupArrays | None:
+        """Current group metadata as device arrays, or None when no
+        group has >= 2 members (the caller then runs the plain
+        ungrouped program — the automatic fallback)."""
+        if not self._dirty:
+            return self._cached
+        self._dirty = False
+        pg = self.page_size
+        buckets: dict[int, list[int]] = {}
+        for seq, run in self._run_of_seq.items():
+            buckets.setdefault(run[0], []).append(seq)
+        groups: list[tuple[int, list[int]]] = []  # (lcp_pages, members)
+        for seqs in buckets.values():
+            if len(seqs) < 2:
+                continue
+            lcp = self._common_prefix([self._run_of_seq[s] for s in seqs])
+            if lcp > 0:
+                groups.append((lcp, sorted(seqs)))
+        groups.sort(key=lambda g: -(g[0] * len(g[1])))
+        groups = groups[: self.max_groups]
+        if not groups:
+            self._cached = None
+            self.saved_tokens_per_step = 0
+            self.largest_group = 0
+            return None
+        gid = np.full((self.max_seqs,), -1, np.int32)
+        rep = np.zeros((self.max_groups,), np.int32)
+        gpages = np.zeros((self.max_groups,), np.int32)
+        start = np.zeros((self.max_seqs,), np.int32)
+        saved = 0
+        largest = 0
+        for g, (lcp, members) in enumerate(groups):
+            rep[g] = members[0]
+            gpages[g] = lcp
+            largest = max(largest, len(members))
+            saved += (len(members) - 1) * lcp * pg
+            for s in members:
+                gid[s] = g
+                start[s] = lcp * pg
+        self.saved_tokens_per_step = saved
+        self.largest_group = largest
+        self.peak_group = max(self.peak_group, largest)
+        self._cached = DecodeGroupArrays(
+            group_id=jnp.asarray(gid),
+            group_rep=jnp.asarray(rep),
+            group_pages=jnp.asarray(gpages),
+            shared_start=jnp.asarray(start),
+        )
+        return self._cached

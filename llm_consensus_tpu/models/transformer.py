@@ -91,8 +91,24 @@ def _pallas_blk(s: int) -> int:
     return max(blk, 1)
 
 
-def _attn_decode(cfg: ModelConfig, q, k_cache, v_cache, valid_len):
+def _attn_decode(
+    cfg: ModelConfig, q, k_cache, v_cache, valid_len, shared_prefix_len=None
+):
+    """``shared_prefix_len`` (traced scalar or None): every row's cache
+    slots [0, shared_prefix_len) hold identical K/V — the
+    shared-prefill fan-out invariant — so the two-phase kernel reads
+    that region ONCE for the whole batch instead of once per row.
+    Engages only on the Pallas path with no sliding window; everything
+    else falls back to the ungrouped read (same outputs)."""
     if cfg.use_pallas and cfg.sliding_window == 0:
+        if shared_prefix_len is not None:
+            from llm_consensus_tpu.ops.pallas import (
+                flash_decode_attention_shared_prefix,
+            )
+
+            return flash_decode_attention_shared_prefix(
+                q, k_cache, v_cache, valid_len, shared_prefix_len
+            )
         from llm_consensus_tpu.ops.pallas import flash_decode_attention
 
         return flash_decode_attention(q, k_cache, v_cache, valid_len)
@@ -148,15 +164,31 @@ def _attn_decode_quant_stacked(
     )
 
 
-def _attn_decode_quant(cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len):
+def _attn_decode_quant(
+    cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len,
+    shared_prefix_len=None,
+):
     """int8-cache decode attention: the Pallas kernel reads int8 straight
     from HBM (the whole point of the quantized cache) but pallas_call is
     opaque to GSPMD, so it is strictly opt-in via ``cfg.use_pallas`` and
     single-device; sharded meshes take the shardable jnp dequant path.
     (ops.quant._use_kernel auto-detects instead — its off-switch is
-    ``ops.quant.set_kernel_enabled(False)``.)"""
+    ``ops.quant.set_kernel_enabled(False)``.)
+
+    ``shared_prefix_len``: as :func:`_attn_decode` — the two-phase
+    shared-prefix kernel reads the fan-out's common prefix KV once for
+    the whole batch (kernel path only; the jnp dequant path has no
+    bandwidth to save and stays ungrouped)."""
     use_kernel = cfg.use_pallas and jax.device_count() == 1
     if use_kernel and cfg.sliding_window == 0:
+        if shared_prefix_len is not None:
+            from llm_consensus_tpu.ops.pallas import (
+                flash_decode_attention_shared_prefix_q8,
+            )
+
+            return flash_decode_attention_shared_prefix_q8(
+                q, k_q, k_s, v_q, v_s, valid_len, shared_prefix_len
+            )
         from llm_consensus_tpu.ops.pallas import flash_decode_attention_q8
 
         return flash_decode_attention_q8(q, k_q, k_s, v_q, v_s, valid_len)
@@ -410,6 +442,7 @@ def _block(
     uniform_write: bool = False,
     mesh=None,
     collect_aux: bool = False,
+    shared_prefix_len=None,
 ):
     """One transformer block.
 
@@ -421,6 +454,11 @@ def _block(
     the SAME position (self-consistency fan-out after shared prefill) —
     the decode cache write becomes one ``dynamic_update_slice`` instead
     of a per-row scatter, which XLA:TPU serializes badly.
+
+    ``shared_prefix_len`` (traced scalar or None; decode mode only):
+    rows share identical cache content in [0, shared_prefix_len) — the
+    decode attention reads that region once for the whole batch via the
+    shared-prefix kernels (see :func:`_attn_decode`).
     """
     h = _rms(cfg, x, p["attn_norm"])
     q, k, v = _project_qkv(cfg, p, h)
@@ -551,7 +589,10 @@ def _block(
                     v[:, 0].astype(v_l.dtype)
                 )
             new_kv = (new_k, new_v)
-            attn = _attn_decode(cfg, q, new_k, new_v, valid_len + 1)
+            attn = _attn_decode(
+                cfg, q, new_k, new_v, valid_len + 1,
+                shared_prefix_len=shared_prefix_len,
+            )
         else:
             kq_l, vq_l, ks_l, vs_l = kv_layer
             kq1, ks1 = quantize_kv(k[:, 0])  # [B,Hkv,D] / [B,Hkv]
@@ -578,7 +619,8 @@ def _block(
                 new_vs = vs_l.at[batch_idx, :, valid_len].set(vs1)
             new_kv = (new_kq, new_vq, new_ks, new_vs)
             attn = _attn_decode_quant(
-                cfg, q, new_kq, new_ks, new_vq, new_vs, valid_len + 1
+                cfg, q, new_kq, new_ks, new_vq, new_vs, valid_len + 1,
+                shared_prefix_len=shared_prefix_len,
             )
     else:  # pragma: no cover
         raise ValueError(mode)
@@ -633,6 +675,7 @@ def _run_layers(
     uniform_write: bool = False,
     mesh=None,
     collect_aux: bool = False,
+    shared_prefix_len=None,
 ):
     """lax.scan over the stacked layer axis (python-unrolled loop when
     ``params["blocks"]`` is a tuple of per-layer dicts — see
@@ -640,6 +683,7 @@ def _run_layers(
 
     ``collect_aux`` (full mode only): also return the per-layer MoE
     router aux losses averaged over layers ({"load_balance", "z_loss"}).
+    ``shared_prefix_len`` (decode mode): see :func:`_block`.
     """
     blocks = params["blocks"]
 
@@ -647,7 +691,7 @@ def _run_layers(
         return _run_layers_unrolled(
             cfg, blocks, x, cos, sin, cache, mode, valid_len, positions,
             remat=remat, uniform_write=uniform_write, mesh=mesh,
-            collect_aux=collect_aux,
+            collect_aux=collect_aux, shared_prefix_len=shared_prefix_len,
         )
 
     if mode == "full":
@@ -735,6 +779,7 @@ def _run_layers(
             positions,
             uniform_write=uniform_write,
             mesh=mesh,
+            shared_prefix_len=shared_prefix_len,
         )
         leaves = tuple(
             jax.lax.dynamic_update_index_in_dim(leaf, nk, layer_idx, axis=0)
@@ -789,6 +834,7 @@ def _run_layers_unrolled(
     uniform_write: bool = False,
     mesh=None,
     collect_aux: bool = False,
+    shared_prefix_len=None,
 ):
     """Python-unrolled layer loop over per-layer weight buffers.
 
@@ -834,6 +880,7 @@ def _run_layers_unrolled(
         x, new_kv = step(
             cfg, p, x, cos, sin, layer_kv, mode, valid_len, positions,
             uniform_write=uniform_write, mesh=mesh,
+            shared_prefix_len=shared_prefix_len,
         )
         leaves = tuple(
             leaf.at[i].set(nk) for leaf, nk in zip(leaves, new_kv)
@@ -940,6 +987,7 @@ def decode_step_paged(
     params: dict,
     tokens: jnp.ndarray,
     cache,
+    groups=None,
 ) -> tuple[jnp.ndarray, object]:
     """One decode step for every cache sequence, paged layout.
 
@@ -948,6 +996,17 @@ def decode_step_paged(
     attends over its gathered pages. Inactive rows (empty tables) write
     into the reserved NULL page — harmless garbage, outputs discarded by
     the serving layer. Returns (logits [max_seqs, V] fp32, new cache).
+
+    ``groups`` (a :class:`~llm_consensus_tpu.models.paged_cache.
+    DecodeGroupArrays` or None): sequences sharing a prefix page run
+    (the PrefixRegistry's CoW mappings) attend that run through the
+    group-aware kernel — one HBM read of the shared pages per GROUP per
+    step instead of one per member, with per-row suffix pages read as
+    before and the two partial softmaxes merged exactly. Grouped and
+    ungrouped rows coexist in the one program (ungrouped rows carry
+    group_id -1). Engages on the Pallas non-windowed path only; the jnp
+    gather path and sliding-window configs ignore ``groups`` (outputs
+    are identical either way — the callers' parity contract).
     """
     from llm_consensus_tpu.models.paged_cache import PagedKVCache
 
@@ -968,6 +1027,9 @@ def decode_step_paged(
     # sequence — per layer per step. Sliding-window configs (Mistral)
     # apply the same window rule inside the kernel.
     use_paged_kernel = cfg.use_pallas
+    use_grouped = (
+        use_paged_kernel and groups is not None and cfg.sliding_window == 0
+    )
 
     def body(carry, layer_in):
         p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
@@ -977,7 +1039,17 @@ def decode_step_paged(
         k = apply_rope(k, cos, sin)
         k_pool = k_pool.at[pages_now, offset].set(k[:, 0].astype(k_pool.dtype))
         v_pool = v_pool.at[pages_now, offset].set(v[:, 0].astype(v_pool.dtype))
-        if use_paged_kernel:
+        if use_grouped:
+            from llm_consensus_tpu.ops.pallas.attention import (
+                paged_decode_attention_grouped,
+            )
+
+            attn = paged_decode_attention_grouped(
+                q[:, 0], k_pool, v_pool, tables, pos + 1,
+                groups.group_id, groups.group_rep, groups.group_pages,
+                groups.shared_start,
+            )[:, None]  # [B, H, D] -> [B, 1, H, D]
+        elif use_paged_kernel:
             from llm_consensus_tpu.ops.pallas.attention import (
                 paged_decode_attention,
             )
@@ -1200,6 +1272,7 @@ def decode_step(
     tokens: jnp.ndarray,
     cache: KVCache,
     uniform_write: bool = False,
+    shared_prefix_len=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """One decode step: tokens [B, 1] -> (logits [B, V] float32, new cache).
 
@@ -1207,6 +1280,14 @@ def decode_step(
     length advances by one. ``uniform_write`` (static): all rows share
     one fill length (shared-prefill fan-out) — the cache write compiles
     to a slice update instead of a scatter.
+
+    ``shared_prefix_len`` (traced scalar or None): rows hold IDENTICAL
+    K/V in cache slots [0, shared_prefix_len) — the shared-prefill
+    fan-out invariant — so decode attention reads that region once for
+    the whole batch through the two-phase shared-prefix kernels (one
+    HBM read per step instead of one per row; exact LSE merge with each
+    row's suffix). Only the Pallas non-windowed non-stacked paths
+    engage; every other path ignores it (same outputs either way).
     """
     x = params["embed"][tokens]  # [B, 1, D]
     positions = cache.length[:, None]  # [B, 1]
@@ -1224,6 +1305,7 @@ def decode_step(
         cache.length,
         None,
         uniform_write=uniform_write,
+        shared_prefix_len=shared_prefix_len,
     )
     logits = _unembed(cfg, params, x[:, 0])
     return logits, cache.advanced(1)

@@ -134,6 +134,137 @@ def decode_attention(
     return _gqa_out(probs, v_cache, q.dtype)
 
 
+def merge_decode_partials(
+    m1: jnp.ndarray,
+    l1: jnp.ndarray,
+    o1: jnp.ndarray,
+    m2: jnp.ndarray,
+    l2: jnp.ndarray,
+    o2: jnp.ndarray,
+) -> jnp.ndarray:
+    """Exact two-way merge of partial softmax-attention results.
+
+    Each partial is the flash-decoding (m, l, o) triple over a disjoint
+    slice of the key/value slots: ``m`` the running max score, ``l`` the
+    softmax denominator at that max, ``o = acc / l`` the normalized
+    partial output (m/l broadcast against o's trailing dims). The merge
+    is the standard log-sum-exp recombination
+
+        m = max(m1, m2);  a_i = l_i * exp(m_i - m)
+        out = (a1 * o1 + a2 * o2) / (a1 + a2)
+
+    which reproduces the single-pass softmax EXACTLY (up to float
+    associativity) — the identity that makes the shared-prefix /
+    per-sequence-suffix attention split lossless. Empty partials ride
+    through as (m = -inf, l = 0): their weight a_i is forced to zero, so
+    a row whose phase contributed nothing (an ungrouped sequence's
+    shared phase) falls back to the other phase's result alone.
+    """
+    m = jnp.maximum(m1, m2)
+    # exp(-inf - -inf) is NaN; substitute 0 for the max when BOTH
+    # phases are empty (the all-masked row — output is garbage anyway,
+    # but it must be finite garbage, mirroring the paged kernel).
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    a1 = jnp.where(l1 > 0, l1 * jnp.exp(m1 - m_safe), 0.0)
+    a2 = jnp.where(l2 > 0, l2 * jnp.exp(m2 - m_safe), 0.0)
+    denom = jnp.maximum(a1 + a2, 1e-30)
+    return (a1 * o1 + a2 * o2) / denom
+
+
+def _partial_softmax(scores: jnp.ndarray, v: jnp.ndarray, mask: jnp.ndarray):
+    """(m, l, o) partial over one masked slot range.
+
+    scores: [B, Hkv, G, 1, S] fp32; v: [B, S, Hkv, D]; mask broadcastable
+    to scores. Returns m/l [B, Hkv, G, 1, 1] and o [B, Hkv, G, 1, D]
+    (normalized; zeros where the range is empty).
+    """
+    scores = jnp.where(mask, scores, _NEG_INF)
+    m = scores.max(axis=-1, keepdims=True)
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    p = jnp.exp(scores - m_safe)
+    l = p.sum(axis=-1, keepdims=True)
+    acc = jnp.einsum(
+        "bkgqs,bskd->bkgqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    o = acc / jnp.maximum(l, 1e-30)
+    return m, l, o
+
+
+def decode_attention_shared_prefix(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+) -> jnp.ndarray:
+    """Two-phase decode attention over a batch sharing one prompt prefix.
+
+    The XLA reference for the shared-prefix kernel family
+    (:mod:`llm_consensus_tpu.ops.pallas`): every row's cache slots
+    [0, prefix_len) hold IDENTICAL K/V (the self-consistency fan-out
+    after a shared prefill), so phase 1 attends all rows' queries
+    against ROW 0's copy of the prefix — one logical read of the common
+    KV — and phase 2 attends each row against its own suffix slots
+    [prefix_len, valid_len). The two partial softmaxes merge exactly
+    via :func:`merge_decode_partials`. Output equals
+    :func:`decode_attention` whenever the shared-prefix precondition
+    holds (and ``prefix_len`` may be 0, degrading to the plain path).
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, max_len, Hkv, D];
+    valid_len: [B]; prefix_len: scalar int32 (uniform — the fan-out's
+    shared prompt length). No sliding-window support: callers fall back
+    to :func:`decode_attention` for windowed configs.
+    """
+    scale = q.shape[-1] ** -0.5
+    b = q.shape[0]
+    max_len = k_cache.shape[1]
+    slot = jnp.arange(max_len)[None, :]  # [1, max_len]
+
+    # Phase 1: all B rows' queries vs row 0's prefix KV.
+    k_shared = k_cache[:1]  # [1, S, Hkv, D] — the one copy phase 1 reads
+    v_shared = v_cache[:1]
+    scores1 = _gqa_scores(q, jnp.broadcast_to(k_shared, k_cache.shape))
+    scores1 = scores1 * scale
+    mask1 = (slot < prefix_len)[:, None, None, None]
+    m1, l1, o1 = _partial_softmax(
+        scores1, jnp.broadcast_to(v_shared, v_cache.shape), mask1
+    )
+
+    # Phase 2: each row vs its own suffix slots [prefix_len, valid).
+    scores2 = _gqa_scores(q, k_cache) * scale
+    mask2 = ((slot >= prefix_len) & (slot < valid_len[:, None]))[
+        :, None, None, None
+    ]
+    m2, l2, o2 = _partial_softmax(scores2, v_cache, mask2)
+
+    out = merge_decode_partials(m1, l1, o1, m2, l2, o2)
+    hkv, g = out.shape[1], out.shape[2]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, 1, hkv * g, -1).astype(
+        q.dtype
+    )
+
+
+def decode_attention_shared_prefix_quant(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+) -> jnp.ndarray:
+    """Shared-prefix decode attention over the int8 head-major cache
+    (jnp reference path — dequantize, defer). Layouts as
+    :func:`decode_attention_quant`."""
+    k = (k_q.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+    v = (v_q.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
+    return decode_attention_shared_prefix(
+        q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        valid_len, prefix_len,
+    )
+
+
 def chunk_decode_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,

@@ -17,7 +17,10 @@ from llm_consensus_tpu.ops.pallas.attention import (
     flash_decode_attention,
     flash_decode_attention_q8,
     flash_decode_attention_q8_stacked,
+    flash_decode_attention_shared_prefix,
+    flash_decode_attention_shared_prefix_q8,
     paged_decode_attention,
+    paged_decode_attention_grouped,
 )
 from llm_consensus_tpu.ops.pallas.norms import fused_rms_norm
 from llm_consensus_tpu.ops.pallas.quant_matmul import quant_matmul_2d
@@ -27,7 +30,10 @@ __all__ = [
     "flash_decode_attention",
     "flash_decode_attention_q8",
     "flash_decode_attention_q8_stacked",
+    "flash_decode_attention_shared_prefix",
+    "flash_decode_attention_shared_prefix_q8",
     "paged_decode_attention",
+    "paged_decode_attention_grouped",
     "fused_rms_norm",
     "quant_matmul_2d",
 ]

@@ -724,3 +724,770 @@ def paged_decode_attention(
         interpret=interpret,
     )(tbl, lens, q4, k_pool, v_pool)
     return out.reshape(b, h, d)
+
+
+# ---------------------------------------------------------------------------
+# Shared-prefix decode attention (two-phase, flash-decoding LSE merge)
+# ---------------------------------------------------------------------------
+#
+# The self-consistency / consensus-panel decode workload is N sequences
+# over ONE shared prompt: the ungrouped kernels above stream the common
+# prefix KV once PER SEQUENCE, so the KV half of the decode roofline
+# scales as N*S instead of S + N*suffix. The kernels below split the
+# attention into
+#
+#   phase 1  all member queries, STACKED, against one copy of the
+#            shared-prefix KV (one HBM read for the whole group; the
+#            per-row GEMV becomes a [N*G, D] x [D, blk] GEMM — MXU
+#            food, not VPU scraps), and
+#   phase 2  each sequence against its own suffix slots only,
+#
+# each emitting flash-decoding (m, l, o) partials that merge EXACTLY via
+# ops.attention.merge_decode_partials (log-sum-exp recombination — the
+# split is lossless, not an approximation). Three layout variants:
+# dense bf16 (the engine's N-fanout cache), dense int8 head-major
+# (kv_quant fan-out), and the paged pool (continuous batching, where
+# groups come from the PrefixRegistry's shared page runs). No
+# sliding-window support anywhere in the family: windowed configs fall
+# back to the ungrouped kernels at the call sites.
+
+
+def _sp_block(s: int, cap: int = 128) -> int:
+    """Largest divisor of ``s`` <= cap — the S-axis block width for the
+    two-phase DENSE kernels (blocks let the suffix pass SKIP the prefix
+    region instead of streaming it per row).
+
+    The cap trades DMA size against skip granularity: the suffix pass
+    can only skip whole blocks, so a prefix shorter than one block
+    saves nothing there while phase 1 still pays one extra read of the
+    prefix region — a bounded overhead of < blk slots per row plus one
+    prefix read, flipping to a win as soon as the prefix spans a block
+    (the canonical fan-out prompt buckets are >= 128). 128 keeps the
+    blocks at lane width and makes that break-even point the smallest
+    bucket the engine serves; the paged variant's unit is the page and
+    needs none of this.
+    """
+    blk = min(cap, s)
+    while s % blk:
+        blk -= 1
+    return blk
+
+
+def _online_fold(m_ref, l_ref, acc_ref, idx, scores, v, v_row_scale=None):
+    """Fold one score block into running (m, l, acc) softmax state.
+
+    ``idx`` selects the scratch slice (slice or int); scores [R, blk]
+    fp32 (already masked to -inf outside the live range); v [blk, D].
+    ``v_row_scale`` [1, blk]: per-slot dequant scale folded into the
+    VALUE product only (the l denominator stays the true softmax sum) —
+    the same linear-dequant trick as :func:`_q8_attend`. The arithmetic
+    is identical to :func:`_paged_decode_kernel`'s in-kernel fold; it
+    lives here once so every two-phase variant shares it.
+    """
+    m_prev = m_ref[idx]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(scores - m_safe)
+    alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, jnp.exp(m_prev - m_safe))
+    l_ref[idx] = l_ref[idx] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p if v_row_scale is None else p * v_row_scale,
+        v.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[idx] = acc_ref[idx] * alpha + pv
+    m_ref[idx] = m_new
+
+
+def _partials_to_rows(m, l, o, b: int, hkv: int, g: int):
+    """Phase-1 partials [Hkv, B*G, *] -> phase-2 row layout [B*Hkv, G, *]."""
+
+    def t(x):
+        return (
+            x.reshape(hkv, b, g, x.shape[-1])
+            .transpose(1, 0, 2, 3)
+            .reshape(b * hkv, g, x.shape[-1])
+        )
+
+    return t(m), t(l), t(o)
+
+
+def _merge_rows(m1, l1, o1, m2, l2, o2, b, hkv, g, d, dtype):
+    """LSE-merge two [B*Hkv, G, *] partial sets -> [B, 1, H, D]."""
+    from llm_consensus_tpu.ops.attention import merge_decode_partials
+
+    out = merge_decode_partials(m1, l1, o1, m2, l2, o2)  # [B*Hkv, G, D]
+    return out.reshape(b, 1, hkv * g, d).astype(dtype)
+
+
+def _sp_shared_kernel(
+    plen_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o, m_s, l_s, acc_s, *,
+    scale: float, blk: int,
+):
+    """Phase 1, dense bf16: one (kv-head, S-block) program over ROW 0's
+    prefix slab with ALL rows' queries stacked.
+
+    plen_ref: [1] prefix length (scalar prefetch — also drives the
+    block remap that collapses DMAs past the prefix); q_ref:
+    [1, B*G, D]; k_ref/v_ref: [1, blk, D] (row 0's slab, blocked);
+    outputs m/l [Hkv, B*G, 1], o [Hkv, B*G, D] fp32 (written at each
+    head's last block); scratch per (B*G) row.
+    """
+    j = pl.program_id(1)
+    nblk = pl.num_programs(1)
+    plen = plen_ref[0]
+    rows, d = q_ref.shape[1], q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full((rows, 1), _NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((rows, 1), jnp.float32)
+        acc_s[...] = jnp.zeros((rows, d), jnp.float32)
+
+    @pl.when(j * blk < plen)
+    def _fold():
+        q = q_ref[0].astype(jnp.float32)  # [B*G, D]
+        scores = jax.lax.dot_general(
+            q,
+            k_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [B*G, blk]
+        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+        scores = jnp.where(slot < plen, scores, _NEG_INF)
+        _online_fold(m_s, l_s, acc_s, ..., scores, v_ref[0])
+
+    @pl.when(j == nblk - 1)
+    def _write():
+        l = l_s[...]
+        m_o[0] = m_s[...]
+        l_o[0] = l
+        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
+
+
+def _sp_suffix_kernel(
+    plen_ref, len_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o, m_s, l_s, acc_s,
+    *, scale: float, blk: int,
+):
+    """Phase 2, dense bf16: one (row x kv-head, S-block) program over the
+    row's OWN suffix slots [prefix_len, valid). Blocks wholly inside the
+    prefix (or past the fill) are skipped — paired with the wrapper's
+    sentinel remap, the suffix pass costs O(suffix), which is the whole
+    point of the split.
+
+    plen_ref: [1]; len_ref: [B*Hkv] per-row fills; q_ref: [1, G, D];
+    k_ref/v_ref: [1, blk, D]; outputs m/l [B*Hkv, G, 1], o
+    [B*Hkv, G, D] fp32.
+    """
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+    nblk = pl.num_programs(1)
+    plen = plen_ref[0]
+    valid = len_ref[r]
+    g, d = q_ref.shape[1], q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full((g, 1), _NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((g, 1), jnp.float32)
+        acc_s[...] = jnp.zeros((g, d), jnp.float32)
+
+    @pl.when(((j + 1) * blk > plen) & (j * blk < valid))
+    def _fold():
+        q = q_ref[0].astype(jnp.float32)  # [G, D]
+        scores = jax.lax.dot_general(
+            q,
+            k_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G, blk]
+        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+        scores = jnp.where((slot >= plen) & (slot < valid), scores, _NEG_INF)
+        _online_fold(m_s, l_s, acc_s, ..., scores, v_ref[0])
+
+    @pl.when(j == nblk - 1)
+    def _write():
+        l = l_s[...]
+        m_o[0] = m_s[...]
+        l_o[0] = l
+        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
+
+
+def flash_decode_attention_shared_prefix(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Shared-prefix decode attention, dense bf16 cache (engine fan-out).
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, max_len, Hkv, D]; valid_len:
+    [B]; prefix_len: traced scalar — every row's slots [0, prefix_len)
+    hold identical K/V (the shared-prefill precondition). Phase 1 reads
+    only ROW 0's copy of that region; phase 2 reads each row's
+    [prefix_len, valid) suffix blocks; merged exactly. Matches
+    :func:`~llm_consensus_tpu.ops.attention.decode_attention_shared_prefix`
+    (and therefore plain decode attention) wherever the precondition
+    holds. No sliding-window support — callers fall back.
+    """
+    b, _, h, d = q.shape
+    s = k_cache.shape[1]
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+    blk = _sp_block(s)
+    nblk = s // blk
+
+    kt = k_cache.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vt = v_cache.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    q_sh = q.reshape(b, hkv, g, d).transpose(1, 0, 2, 3).reshape(hkv, b * g, d)
+    q_row = q.reshape(b, hkv, g, d).reshape(b * hkv, g, d)
+    plen = jnp.atleast_1d(prefix_len).astype(jnp.int32)
+    lens = jnp.repeat(valid_len.astype(jnp.int32), hkv)
+
+    def _shared_map(hi, j, plen):
+        return (hi, jnp.where(j * blk < plen[0], j, 0), 0)
+
+    m1, l1, o1 = pl.pallas_call(
+        functools.partial(_sp_shared_kernel, scale=scale, blk=blk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(hkv, nblk),
+            in_specs=[
+                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
+                pl.BlockSpec((1, blk, d), _shared_map),
+                pl.BlockSpec((1, blk, d), _shared_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
+                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
+                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((b * g, 1), jnp.float32),
+                pltpu.VMEM((b * g, 1), jnp.float32),
+                pltpu.VMEM((b * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * g, d), jnp.float32),
+        ),
+        interpret=interpret,
+    )(plen, q_sh, kt[:hkv], vt[:hkv])
+
+    def _suffix_map(r, j, plen, lens):
+        live = ((j + 1) * blk > plen[0]) & (j * blk < lens[r])
+        return (r, jnp.where(live, j, 0), 0)
+
+    m2, l2, o2 = pl.pallas_call(
+        functools.partial(_sp_suffix_kernel, scale=scale, blk=blk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * hkv, nblk),
+            in_specs=[
+                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
+                pl.BlockSpec((1, blk, d), _suffix_map),
+                pl.BlockSpec((1, blk, d), _suffix_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
+                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
+                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * hkv, g, d), jnp.float32),
+        ),
+        interpret=interpret,
+    )(plen, lens, q_row, kt, vt)
+
+    m1r, l1r, o1r = _partials_to_rows(m1, l1, o1, b, hkv, g)
+    return _merge_rows(m1r, l1r, o1r, m2, l2, o2, b, hkv, g, d, q.dtype)
+
+
+def _sp_shared_q8_kernel(
+    plen_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, m_o, l_o, o_o,
+    m_s, l_s, acc_s, *, scale: float, blk: int,
+):
+    """Phase 1, int8 head-major: as :func:`_sp_shared_kernel` with the
+    per-slot dequant scales folded into scores/values (`_q8_attend`'s
+    linear-dequant trick). kq_ref/vq_ref: [1, blk, D] int8;
+    ks_ref/vs_ref: [1, 1, blk] f32 — row 0's slabs only."""
+    j = pl.program_id(1)
+    nblk = pl.num_programs(1)
+    plen = plen_ref[0]
+    rows, d = q_ref.shape[1], q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full((rows, 1), _NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((rows, 1), jnp.float32)
+        acc_s[...] = jnp.zeros((rows, d), jnp.float32)
+
+    @pl.when(j * blk < plen)
+    def _fold():
+        q = q_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q,
+            kq_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (ks_ref[0] * scale)  # [B*G, blk] * [1, blk]
+        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+        scores = jnp.where(slot < plen, scores, _NEG_INF)
+        _online_fold(
+            m_s, l_s, acc_s, ..., scores, vq_ref[0], v_row_scale=vs_ref[0]
+        )
+
+    @pl.when(j == nblk - 1)
+    def _write():
+        l = l_s[...]
+        m_o[0] = m_s[...]
+        l_o[0] = l
+        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
+
+
+def _sp_suffix_q8_kernel(
+    plen_ref, len_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, m_o, l_o, o_o,
+    m_s, l_s, acc_s, *, scale: float, blk: int,
+):
+    """Phase 2, int8 head-major: as :func:`_sp_suffix_kernel` with
+    dequant scales folded in."""
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+    nblk = pl.num_programs(1)
+    plen = plen_ref[0]
+    valid = len_ref[r]
+    g, d = q_ref.shape[1], q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full((g, 1), _NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((g, 1), jnp.float32)
+        acc_s[...] = jnp.zeros((g, d), jnp.float32)
+
+    @pl.when(((j + 1) * blk > plen) & (j * blk < valid))
+    def _fold():
+        q = q_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q,
+            kq_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (ks_ref[0] * scale)
+        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+        scores = jnp.where((slot >= plen) & (slot < valid), scores, _NEG_INF)
+        _online_fold(
+            m_s, l_s, acc_s, ..., scores, vq_ref[0], v_row_scale=vs_ref[0]
+        )
+
+    @pl.when(j == nblk - 1)
+    def _write():
+        l = l_s[...]
+        m_o[0] = m_s[...]
+        l_o[0] = l
+        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
+
+
+def flash_decode_attention_shared_prefix_q8(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Shared-prefix decode attention over the int8 head-major cache.
+
+    q: [B, 1, H, D]; k_q/v_q: [B, Hkv, S, D] int8 (QuantKVCache layout —
+    the per-(row, head) slab reshape is zero-copy); k_scale/v_scale:
+    [B, Hkv, S] f32; valid_len: [B]; prefix_len: traced scalar. Same
+    two-phase split as :func:`flash_decode_attention_shared_prefix`;
+    HBM reads stay int8 + one f32 scale per slot.
+    """
+    b, _, h, d = q.shape
+    hkv, s = k_q.shape[1], k_q.shape[2]
+    g = h // hkv
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+    blk = _sp_block(s)
+    nblk = s // blk
+
+    kq2 = k_q.reshape(b * hkv, s, d)
+    vq2 = v_q.reshape(b * hkv, s, d)
+    ks2 = k_scale.reshape(b * hkv, 1, s)
+    vs2 = v_scale.reshape(b * hkv, 1, s)
+    q_sh = q.reshape(b, hkv, g, d).transpose(1, 0, 2, 3).reshape(hkv, b * g, d)
+    q_row = q.reshape(b * hkv, g, d)
+    plen = jnp.atleast_1d(prefix_len).astype(jnp.int32)
+    lens = jnp.repeat(valid_len.astype(jnp.int32), hkv)
+
+    def _shared_map(hi, j, plen):
+        return (hi, jnp.where(j * blk < plen[0], j, 0), 0)
+
+    def _shared_scale_map(hi, j, plen):
+        return (hi, 0, jnp.where(j * blk < plen[0], j, 0))
+
+    m1, l1, o1 = pl.pallas_call(
+        functools.partial(_sp_shared_q8_kernel, scale=scale, blk=blk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(hkv, nblk),
+            in_specs=[
+                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
+                pl.BlockSpec((1, blk, d), _shared_map),
+                pl.BlockSpec((1, 1, blk), _shared_scale_map),
+                pl.BlockSpec((1, blk, d), _shared_map),
+                pl.BlockSpec((1, 1, blk), _shared_scale_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
+                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
+                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((b * g, 1), jnp.float32),
+                pltpu.VMEM((b * g, 1), jnp.float32),
+                pltpu.VMEM((b * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * g, d), jnp.float32),
+        ),
+        interpret=interpret,
+    )(plen, q_sh, kq2[:hkv], ks2[:hkv], vq2[:hkv], vs2[:hkv])
+
+    def _suffix_map(r, j, plen, lens):
+        live = ((j + 1) * blk > plen[0]) & (j * blk < lens[r])
+        return (r, jnp.where(live, j, 0), 0)
+
+    def _suffix_scale_map(r, j, plen, lens):
+        live = ((j + 1) * blk > plen[0]) & (j * blk < lens[r])
+        return (r, 0, jnp.where(live, j, 0))
+
+    m2, l2, o2 = pl.pallas_call(
+        functools.partial(_sp_suffix_q8_kernel, scale=scale, blk=blk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * hkv, nblk),
+            in_specs=[
+                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
+                pl.BlockSpec((1, blk, d), _suffix_map),
+                pl.BlockSpec((1, 1, blk), _suffix_scale_map),
+                pl.BlockSpec((1, blk, d), _suffix_map),
+                pl.BlockSpec((1, 1, blk), _suffix_scale_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
+                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
+                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * hkv, g, d), jnp.float32),
+        ),
+        interpret=interpret,
+    )(plen, lens, q_row, kq2, ks2, vq2, vs2)
+
+    m1r, l1r, o1r = _partials_to_rows(m1, l1, o1, b, hkv, g)
+    return _merge_rows(m1r, l1r, o1r, m2, l2, o2, b, hkv, g, d, q.dtype)
+
+
+# -- paged variant: groups over the page pool -------------------------------
+
+
+def _paged_shared_kernel(
+    rep_ref, gp_ref, tbl_ref, gid_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o,
+    m_s, l_s, acc_s, *, scale: float,
+):
+    """Phase 1, paged: one (group, shared-page) program — every row's
+    queries STACKED against the group's shared page run (read once per
+    group via the representative row's table), non-members masked out.
+
+    rep_ref/gp_ref: [Gm] representative row / shared-page count per
+    group (scalar prefetch; gp == 0 for padding groups);
+    tbl_ref: [B*P] flattened page table (consumed by the index map);
+    gid_ref: [B, 1] VMEM group id per row (-1 = ungrouped); q_ref:
+    [Hkv, B*G, D]; k_ref/v_ref: [1, pg, Hkv, D] — one pool page.
+    Outputs m/l [Hkv, B*G, 1], o [Hkv, B*G, D] fp32, written once at
+    the very last program. Scratch is per (head, row) and accumulates
+    across ALL groups: each row belongs to at most one group, so its
+    rows of the scratch only ever fold scores from that group's pages.
+    """
+    gi = pl.program_id(0)
+    ji = pl.program_id(1)
+    last = (gi == pl.num_programs(0) - 1) & (ji == pl.num_programs(1) - 1)
+    hkv, rows, d = q_ref.shape
+    bsz = gid_ref.shape[0]
+    g = rows // bsz
+    pg = k_ref.shape[1]
+
+    @pl.when((gi == 0) & (ji == 0))
+    def _init():
+        m_s[...] = jnp.full((hkv, rows, 1), _NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((hkv, rows, 1), jnp.float32)
+        acc_s[...] = jnp.zeros((hkv, rows, d), jnp.float32)
+
+    @pl.when(ji < gp_ref[gi])
+    def _fold_page():
+        member = gid_ref[...] == gi  # [B, 1]
+        mrow = jnp.broadcast_to(member, (bsz, g)).reshape(rows, 1)
+        for head in range(hkv):  # static unroll over kv heads
+            q = q_ref[head].astype(jnp.float32)  # [B*G, D]
+            scores = jax.lax.dot_general(
+                q,
+                k_ref[0, :, head, :].astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [B*G, pg]
+            scores = jnp.where(mrow, scores, _NEG_INF)
+            _online_fold(
+                m_s, l_s, acc_s, head, scores, v_ref[0, :, head, :]
+            )
+
+    @pl.when(last)
+    def _write():
+        l = l_s[...]
+        m_o[...] = m_s[...]
+        l_o[...] = l
+        o_o[...] = acc_s[...] / jnp.maximum(l, 1e-30)
+
+
+def _paged_suffix_kernel(
+    start_ref, tbl_ref, len_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o,
+    m_s, l_s, acc_s, *, scale: float,
+):
+    """Phase 2, paged: the per-row page walk of
+    :func:`_paged_decode_kernel`, restricted to the row's OWN suffix
+    pages (pages wholly inside the shared run are skipped — paired with
+    the wrapper's sentinel remap their DMAs collapse) and emitting
+    (m, l, o) partials instead of the final normalize.
+
+    start_ref: [B] first unshared token per row (0 = whole row, the
+    ungrouped case); len_ref: [B]; q_ref: [1, Hkv, G, D];
+    k_ref/v_ref: [1, pg, Hkv, D]; outputs m/l [B, Hkv*G, 1],
+    o [B, Hkv, G, D].
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    _, pg, hkv, d = k_ref.shape
+    g = q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full((hkv * g, 1), _NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((hkv * g, 1), jnp.float32)
+        acc_s[...] = jnp.zeros((hkv * g, d), jnp.float32)
+
+    start = start_ref[b]
+    valid = len_ref[b]
+
+    @pl.when(((j + 1) * pg > start) & (j * pg < valid))
+    def _fold_page():
+        slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
+        in_range = (slot >= start) & (slot < valid)
+        for head in range(hkv):  # static unroll over kv heads
+            hs = slice(head * g, (head + 1) * g)
+            q = q_ref[0, head].astype(jnp.float32)  # [G, D]
+            scores = jax.lax.dot_general(
+                q,
+                k_ref[0, :, head, :].astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, pg]
+            scores = jnp.where(in_range, scores, _NEG_INF)
+            _online_fold(
+                m_s, l_s, acc_s, hs, scores, v_ref[0, :, head, :]
+            )
+
+    @pl.when(j == n_pages - 1)
+    def _write():
+        l = l_s[...]
+        m_o[0] = m_s[...]
+        l_o[0] = l
+        o_o[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).reshape(hkv, g, d)
+
+
+def paged_decode_attention_grouped(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    group_id: jnp.ndarray,
+    group_rep: jnp.ndarray,
+    group_pages: jnp.ndarray,
+    shared_start: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Group-aware paged decode attention (serving hot path).
+
+    q: [B, H, D]; k_pool/v_pool: [n_pages, page, Hkv, D]; page_table:
+    [B, P]; valid_len: [B]. Group metadata (built by
+    :class:`~llm_consensus_tpu.models.paged_cache.GroupTracker` from the
+    PrefixRegistry's shared page runs, all int32):
+
+    - group_id [B]: group per row, -1 for ungrouped rows;
+    - group_rep [Gm]: a member row whose table phase 1 walks;
+    - group_pages [Gm]: pages in the group's shared run (0 = padding);
+    - shared_start [B]: tokens phase 1 covers for the row (page-aligned;
+      0 for ungrouped rows, whose phase 2 then walks the whole row).
+
+    Phase 1 streams each group's shared run ONCE for all members
+    (the ungrouped kernel streams it once per member — the N*S -> S +
+    N*suffix KV-bandwidth reduction this family exists for); phase 2
+    walks per-row suffix pages only; exact LSE merge. Grouped and
+    ungrouped rows coexist: a row with group_id == -1 gets its entire
+    result from phase 2. Output-equal to
+    :func:`paged_decode_attention` (same masking semantics, same
+    arithmetic, reordered reductions). No sliding-window support —
+    callers fall back to the ungrouped kernel for windowed configs.
+    """
+    b, h, d = q.shape
+    n_pages, pg, hkv, _ = k_pool.shape
+    p_per = page_table.shape[1]
+    g = h // hkv
+    gm = group_rep.shape[0]
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+
+    tbl = page_table.reshape(-1).astype(jnp.int32)
+    lens = valid_len.astype(jnp.int32)
+    rep = group_rep.astype(jnp.int32)
+    gpages = group_pages.astype(jnp.int32)
+    start = shared_start.astype(jnp.int32)
+    gid_v = group_id.astype(jnp.int32).reshape(b, 1)
+    q_sh = q.reshape(b, hkv, g, d).transpose(1, 0, 2, 3).reshape(hkv, b * g, d)
+    q4 = q.reshape(b, hkv, g, d)
+
+    def _shared_page_map(gi, ji, rep, gpages, tbl):
+        page = tbl[rep[gi] * p_per + ji]
+        return (jnp.where(ji < gpages[gi], page, 0), 0, 0, 0)
+
+    m1, l1, o1 = pl.pallas_call(
+        functools.partial(_paged_shared_kernel, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # rep, gpages, tbl
+            grid=(gm, p_per),
+            in_specs=[
+                pl.BlockSpec(
+                    (b, 1), lambda gi, ji, rep, gpages, tbl: (0, 0)
+                ),
+                pl.BlockSpec(
+                    (hkv, b * g, d),
+                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
+                ),
+                pl.BlockSpec((1, pg, hkv, d), _shared_page_map),
+                pl.BlockSpec((1, pg, hkv, d), _shared_page_map),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (hkv, b * g, 1),
+                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (hkv, b * g, 1),
+                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (hkv, b * g, d),
+                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((hkv, b * g, 1), jnp.float32),
+                pltpu.VMEM((hkv, b * g, 1), jnp.float32),
+                pltpu.VMEM((hkv, b * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * g, d), jnp.float32),
+        ),
+        interpret=interpret,
+    )(rep, gpages, tbl, gid_v, q_sh, k_pool, v_pool)
+
+    def _suffix_page_map(bi, ji, start, tbl, lens):
+        live = ((ji + 1) * pg > start[bi]) & (ji * pg < lens[bi])
+        page = tbl[bi * p_per + ji]
+        return (jnp.where(live, page, 0), 0, 0, 0)
+
+    m2, l2, o2 = pl.pallas_call(
+        functools.partial(_paged_suffix_kernel, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # start, tbl, lens
+            grid=(b, p_per),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, hkv, g, d),
+                    lambda bi, ji, start, tbl, lens: (bi, 0, 0, 0),
+                ),
+                pl.BlockSpec((1, pg, hkv, d), _suffix_page_map),
+                pl.BlockSpec((1, pg, hkv, d), _suffix_page_map),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, hkv * g, 1),
+                    lambda bi, ji, start, tbl, lens: (bi, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, hkv * g, 1),
+                    lambda bi, ji, start, tbl, lens: (bi, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, hkv, g, d),
+                    lambda bi, ji, start, tbl, lens: (bi, 0, 0, 0),
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((hkv * g, 1), jnp.float32),
+                pltpu.VMEM((hkv * g, 1), jnp.float32),
+                pltpu.VMEM((hkv * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, g, d), jnp.float32),
+        ),
+        interpret=interpret,
+    )(start, tbl, lens, q4, k_pool, v_pool)
+
+    from llm_consensus_tpu.ops.attention import merge_decode_partials
+
+    m1r = m1.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
+    l1r = l1.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
+    o1r = o1.reshape(hkv, b, g, d).transpose(1, 0, 2, 3)
+    m2r = m2.reshape(b, hkv, g, 1)
+    l2r = l2.reshape(b, hkv, g, 1)
+    out = merge_decode_partials(m1r, l1r, o1r, m2r, l2r, o2)
+    return out.reshape(b, h, d).astype(q.dtype)

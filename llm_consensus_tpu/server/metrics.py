@@ -37,6 +37,8 @@ __all__ = [
     "PREFIX_LOOKUPS",
     "PREFIX_HITS",
     "PREFILL_STALL_SECONDS",
+    "SHARED_KV_BYTES_SAVED",
+    "DECODE_GROUP_SIZE",
 ]
 
 # Seconds: spans ~1 ms .. 2 min, the TTFT / request-latency range of a
@@ -345,4 +347,22 @@ PREFILL_STALL_SECONDS = REGISTRY.histogram(
     "gateway_prefill_stall_seconds",
     "Decode-loop stall per prefill work unit (chunk or blocking prefill)",
     buckets=LATENCY_BUCKETS,
+)
+#: KV bytes the group-aware decode kernel did NOT re-read from HBM
+#: (PR 3: shared-prefix decode attention). Each decode step reads a
+#: group's shared-prefix pages once instead of once per member; this
+#: counts the skipped (members - 1) * shared_tokens * bytes-per-token
+#: reads — the dedup PR 2's page sharing made possible in memory, now
+#: realized in bandwidth. Incremented only when the grouped program
+#: actually ran (jnp-path and windowed-config fallbacks save nothing).
+SHARED_KV_BYTES_SAVED = REGISTRY.counter(
+    "gateway_shared_kv_bytes_saved_total",
+    "KV-cache HBM bytes deduped by group-aware decode attention",
+)
+#: Members in the largest active decode group at the most recent step
+#: (0 = no group — the ungrouped program ran). The panel's N-fanout
+#: shows up here as N.
+DECODE_GROUP_SIZE = REGISTRY.gauge(
+    "gateway_decode_group_size",
+    "Largest shared-prefix decode group at the last decode step",
 )

@@ -77,6 +77,7 @@ from llm_consensus_tpu.models.cache import KVCache
 from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.models.paged_cache import (
     NULL_PAGE,
+    GroupTracker,
     PagedKVCache,
     PagePool,
     PrefixRegistry,
@@ -106,6 +107,12 @@ from llm_consensus_tpu.server.metrics import (
 )
 from llm_consensus_tpu.server.metrics import (
     PREFIX_PAGES_SHARED as _M_PREFIX_SHARED,
+)
+from llm_consensus_tpu.server.metrics import (
+    DECODE_GROUP_SIZE as _M_GROUP_SIZE,
+)
+from llm_consensus_tpu.server.metrics import (
+    SHARED_KV_BYTES_SAVED as _M_KV_SAVED,
 )
 from llm_consensus_tpu.server.metrics import REGISTRY as _REG
 
@@ -175,6 +182,15 @@ class ContinuousConfig:
     # instead of re-prefilling them. Requires prefill_chunk > 0 (the
     # chunk program is what can START a prefill mid-prompt).
     share_prefix: bool = True
+    # Group-aware decode attention (PR 3): sequences whose tables share
+    # a prefix page run read it ONCE per step through the grouped
+    # Pallas kernel instead of once per member. Engages only when
+    # share_prefix is on, the model runs the Pallas paged kernel
+    # (cfg.use_pallas, single device, no sliding window), and a >= 2
+    # member group exists this step — otherwise the plain row kernel
+    # runs, outputs identical. Off = always the plain kernel (the
+    # bench's A/B baseline).
+    prefix_attention: bool = True
 
 
 @dataclass
@@ -304,6 +320,28 @@ class ContinuousBatcher:
         self._registries = [
             PrefixRegistry(pool, c.page_size) for pool in self._pools
         ]
+        # Group-aware decode attention: derive per-step groups from
+        # shared prefix page runs. Engages only where the grouped
+        # Pallas kernel can run (single device, no sliding window, the
+        # paged kernel path itself on) — everywhere else the tracker
+        # stays empty and the plain row kernel runs (the documented
+        # fallback set; README Serving).
+        self._group_decode = (
+            c.prefix_attention
+            and c.share_prefix
+            and c.prefill_chunk > 0
+            and cfg.use_pallas
+            and cfg.sliding_window == 0
+            and mesh is None
+        )
+        self._groups = GroupTracker(c.max_slots, c.page_size)
+        # KV bytes one token costs per read across all layers (k + v,
+        # pool dtype) — the unit of gateway_shared_kv_bytes_saved_total.
+        kv_dtype_bytes = jnp.dtype(self.cache.k.dtype).itemsize
+        self._kv_token_bytes = (
+            cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2 * kv_dtype_bytes
+        )
+        self._kv_bytes_saved = 0
         self._slots: list[_Slot | None] = [None] * c.max_slots
         self._waiting: deque[_Request] = deque()
         self._last_tokens = np.zeros((c.max_slots,), np.int32)
@@ -356,6 +394,7 @@ class ContinuousBatcher:
         topks,
         topps,
         filters_active,
+        groups=None,
     ):
         """``steps_per_sync`` decode+sample steps as ONE device program.
 
@@ -363,13 +402,19 @@ class ContinuousBatcher:
         Each step folds ``(seed, count+j)`` into the per-slot PRNG —
         the same stream a chunk-of-1 loop would draw, so results are
         chunk-size-invariant (tested).
+
+        ``groups`` (DecodeGroupArrays or None): per-step decode-group
+        metadata — shared prefix pages read once per group through the
+        grouped kernel. None compiles/runs the plain program (the two
+        variants are separate cached traces; membership CHANGES within
+        a variant are pure data and never recompile).
         """
         k = max(1, self.config.steps_per_sync)
 
         def body(carry, _):
             cache, tok, cnt = carry
             logits, cache = decode_step_paged(
-                self.cfg, params, tok[:, None], cache
+                self.cfg, params, tok[:, None], cache, groups=groups
             )
             keys = jax.vmap(
                 lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
@@ -529,6 +574,13 @@ class ContinuousBatcher:
                 "prefix_pages_shared": sum(r.pages_shared for r in regs),
                 "prefix_pages_copied": sum(r.pages_copied for r in regs),
                 "prefix_evictions": sum(r.evictions for r in regs),
+                # Group-aware decode attention (PR 3): KV bytes the
+                # grouped kernel did not re-read, the largest active
+                # group right now (0 = ungrouped program), and the
+                # lifetime peak group size.
+                "shared_kv_bytes_saved": self._kv_bytes_saved,
+                "decode_group_size": self._groups.largest_group,
+                "decode_group_peak": self._groups.peak_group,
             }
 
     def close(self) -> None:
@@ -849,6 +901,15 @@ class ContinuousBatcher:
         slot.generated.append(first)
         slot.phase = "decode"
         slot.deps = []
+        if self._group_decode:
+            # The row's prompt-prefix page run (full pages only — the
+            # boundary page takes decode writes and must stay suffix).
+            # Same page ids across rows == same tokens (sharing happens
+            # only through the registry), so the tracker groups rows by
+            # common run prefix: the panel's donor AND its mappers.
+            self._groups.add(
+                idx, slot.pages[: slot.prompt_len // self.config.page_size]
+            )
         with self._lock:
             _M_ACTIVE.set(self._decoding())
         self._last_tokens[idx] = first
@@ -962,6 +1023,10 @@ class ContinuousBatcher:
     def _retire(self, idx: int) -> None:
         slot = self._slots[idx]
         assert slot is not None
+        # Groups shrink incrementally as members retire; a group left
+        # with one member stops emitting (its row falls back to the
+        # plain per-row walk — nothing left to dedup).
+        self._groups.remove(idx)
         self.cache = release_seq(self.cache, jnp.int32(idx))
         pool = self._pools[self._shard_of_slot[idx]]
         with self._lock:
@@ -1010,6 +1075,7 @@ class ContinuousBatcher:
                 arr = jax.device_put(arr, self._row_sharding)
             return arr
 
+        groups = self._groups.arrays() if self._group_decode else None
         next_tok, _, self.cache = self._jit_decode(
             self.params,
             self.cache,
@@ -1020,12 +1086,25 @@ class ContinuousBatcher:
             rows(self._topks),
             rows(self._topps),
             filters_active,
+            groups,
         )
         k = max(1, self.config.steps_per_sync)
         with self._lock:
             self._decode_steps += k
             active = self._decoding()
+            if groups is not None:
+                # Shared pages read once per group instead of once per
+                # member: count the reads the device program skipped.
+                saved = (
+                    self._groups.saved_tokens_per_step
+                    * self._kv_token_bytes
+                    * k
+                )
+                self._kv_bytes_saved += saved
         _M_STEPS.inc(k)
+        _M_GROUP_SIZE.set(self._groups.largest_group if groups is not None else 0)
+        if groups is not None:
+            _M_KV_SAVED.inc(saved)
         if active:
             _M_OCCUPANCY.observe(active)
         next_np = np.asarray(next_tok)  # [slots, k] — THE host sync

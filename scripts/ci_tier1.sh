@@ -5,13 +5,14 @@
 # pytest's; DOTS_PASSED echoes the per-test pass count the growth driver
 # compares against the seed.
 #
-#   --smoke   fast paged-serving slice (~1 min) for iterating on the
-#             continuous batcher / page-table stack without the full
-#             ~15 min suite.
+#   --smoke   fast paged-serving slice (~2 min) for iterating on the
+#             continuous batcher / page-table / shared-prefix-attention
+#             stack without the full ~15 min suite.
 cd "$(dirname "$0")/.." || exit 1
 if [ "$1" = "--smoke" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_paged_cache.py tests/test_server.py \
+    tests/test_shared_prefix_attention.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 set -o pipefail
