@@ -25,6 +25,40 @@ import jax
 import jax.numpy as jnp
 
 
+def _atomic_write_text(path: str, text: str) -> None:
+    """Write an artifact via tmp file + ``os.replace``: a mid-write
+    container recycle must leave either the previous artifact or the
+    complete new one on disk — never a committed 0-byte file (round 5
+    landed exactly that for spec_trained_r5.json, VERDICT.md)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _emit(payload: dict, out: str | None) -> None:
+    """The ONE result sink every leg shares: the JSON line goes to
+    stdout (the historical contract scripts tail) and — with ``--out``
+    — atomically to the artifact path, so driver scripts stop relying
+    on shell redirection that can tear."""
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out:
+        _atomic_write_text(out, line + "\n")
+
+
 # The ONE probe body, run both in-process (_chip_responsive, via exec)
 # and as a subprocess (_await_chip). Salted operand: the tunnel replays
 # previously-seen (executable, inputs) pairs across processes — a fixed
@@ -219,6 +253,32 @@ def main() -> int:
         "generated text is unchanged",
     )
     p.add_argument(
+        "--serve-offload",
+        action="store_true",
+        help="hierarchical-KV A/B leg: a multi-round panel burst (same "
+        "shared header re-sent round after round, interleaved with "
+        "unique-prefix filler rounds) over a page pool sized BELOW the "
+        "working set, served with the host-RAM offload tier ON "
+        "(eviction demotes prefix pages to host; later rounds restore "
+        "them) vs OFF (eviction destroys; later rounds re-prefill) — "
+        "reporting restored pages, prefill tokens saved, per-page "
+        "restore latency, and that the generated text is unchanged",
+    )
+    p.add_argument(
+        "--serve-host-cache-mb",
+        type=int,
+        default=256,
+        help="host-RAM KV tier byte budget for --serve-offload "
+        "(ContinuousConfig.host_cache_bytes, in MiB)",
+    )
+    p.add_argument(
+        "--out",
+        default="",
+        help="also write the final JSON line to this path ATOMICALLY "
+        "(tmp + os.replace) — driver scripts should prefer this over "
+        "shell redirection, which can commit a torn 0-byte artifact",
+    )
+    p.add_argument(
         "--fanout-prefix-ab",
         action="store_true",
         help="engine-level A/B leg: the N-candidate shared-prefill "
@@ -270,20 +330,18 @@ def main() -> int:
         # mid-round-4); a bench that hangs forever is worse than an
         # explicit failure record. _await_chip bridges short outages
         # first (subprocess probes, BENCH_CHIP_WAIT_S budget).
-        print(
-            json.dumps(
-                {
-                    "metric": "CHIP UNREACHABLE (subprocess probes "
-                    f"failed for the {wait_budget:.0f}s wait budget "
-                    "and/or the in-process preflight did not complete "
-                    f"in {probe_timeout:.0f}s; per-attempt errors on "
-                    "stderr)",
-                    "value": 0.0,
-                    "unit": "tokens/sec/chip",
-                    "vs_baseline": 0.0,
-                }
-            ),
-            flush=True,
+        _emit(
+            {
+                "metric": "CHIP UNREACHABLE (subprocess probes "
+                f"failed for the {wait_budget:.0f}s wait budget "
+                "and/or the in-process preflight did not complete "
+                f"in {probe_timeout:.0f}s; per-attempt errors on "
+                "stderr)",
+                "value": 0.0,
+                "unit": "tokens/sec/chip",
+                "vs_baseline": 0.0,
+            },
+            args.out,
         )
         # _exit, not return: the JAX runtime's shutdown hooks block on
         # the same dead tunnel the probe just diagnosed.
@@ -359,6 +417,8 @@ def main() -> int:
 
     if args.draft:
         return _bench_speculative(args, cfg, params, tokens, lengths)
+    if args.serve_offload:
+        return _bench_serving_offload(args, cfg, params)
     if args.serve_prefix_attention:
         return _bench_serving_prefix_ab(args, cfg, params)
     if args.fanout_prefix_ab:
@@ -434,24 +494,23 @@ def main() -> int:
     n_chips = jax.device_count()
     tps_per_chip = tps / n_chips
 
-    print(
-        json.dumps(
-            {
-                "metric": f"candidate-tokens/sec/chip ({cfg.name}, N={b}, "
-                f"decode {args.new_tokens} @ prompt {s}, quant={args.quant}, "
-                f"kv={args.kv_quant}, pallas={cfg.use_pallas}"
-                + (
-                    # Which MLP path the N-token DECODE program traced.
-                    (", moe=dense" if cfg.moe_dense_at(b) else ", moe=capacity")
-                    if cfg.is_moe
-                    else ""
-                )
-                + f"{fallback})",
-                "value": round(tps_per_chip, 2),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(tps_per_chip / 1000.0, 4),
-            }
-        )
+    _emit(
+        {
+            "metric": f"candidate-tokens/sec/chip ({cfg.name}, N={b}, "
+            f"decode {args.new_tokens} @ prompt {s}, quant={args.quant}, "
+            f"kv={args.kv_quant}, pallas={cfg.use_pallas}"
+            + (
+                # Which MLP path the N-token DECODE program traced.
+                (", moe=dense" if cfg.moe_dense_at(b) else ", moe=capacity")
+                if cfg.is_moe
+                else ""
+            )
+            + f"{fallback})",
+            "value": round(tps_per_chip, 2),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(tps_per_chip / 1000.0, 4),
+        },
+        args.out,
     )
     return 0
 
@@ -543,19 +602,18 @@ def _bench_speculative(args, cfg, params, tokens, lengths) -> int:
     acc = float(out.accepted) / max(1.0, float(out.drafted))
     spec_tps = produced / spec_wall
     plain_tps = b * args.new_tokens / plain_wall
-    print(
-        json.dumps(
-            {
-                "metric": f"speculative tokens/sec/chip ({cfg.name} + draft "
-                f"{d_cfg.name}, N={b}, k={args.k_spec}, decode "
-                f"{args.new_tokens} @ prompt {tokens.shape[1]}, "
-                f"acceptance={acc:.3f}, plain={plain_tps:.0f} tok/s, "
-                f"speedup={spec_tps / plain_tps:.2f}x)",
-                "value": round(spec_tps, 2),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(spec_tps / 1000.0, 4),
-            }
-        )
+    _emit(
+        {
+            "metric": f"speculative tokens/sec/chip ({cfg.name} + draft "
+            f"{d_cfg.name}, N={b}, k={args.k_spec}, decode "
+            f"{args.new_tokens} @ prompt {tokens.shape[1]}, "
+            f"acceptance={acc:.3f}, plain={plain_tps:.0f} tok/s, "
+            f"speedup={spec_tps / plain_tps:.2f}x)",
+            "value": round(spec_tps, 2),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(spec_tps / 1000.0, 4),
+        },
+        args.out,
     )
     return 0
 
@@ -664,23 +722,22 @@ def _bench_serving_prefix_ab(args, cfg, params) -> int:
     texts_on, tps_on, saved_on, stats_on = run(True)
     texts_off, tps_off, saved_off, _ = run(False)
     unchanged = texts_on == texts_off
-    print(
-        json.dumps(
-            {
-                "metric": f"serving tok/s, grouped prefix attention "
-                f"({cfg.name}, {args.serve_requests} reqs, "
-                f"slots={args.serve_slots}, decode {args.new_tokens} @ "
-                f"~{args.prompt_len} shared prompt, chunk="
-                f"{args.serve_chunk}, kernel OFF {tps_off:.0f} tok/s, "
-                f"shared-KV saved {saved_on} B "
-                f"[{saved_on / 2**20:.2f} MiB] (off leg {saved_off} B), "
-                f"peak group {stats_on['decode_group_peak']}, "
-                f"text unchanged={unchanged})",
-                "value": round(tps_on, 2),
-                "unit": "tokens/sec",
-                "vs_baseline": round(tps_on / max(tps_off, 1e-9), 4),
-            }
-        )
+    _emit(
+        {
+            "metric": f"serving tok/s, grouped prefix attention "
+            f"({cfg.name}, {args.serve_requests} reqs, "
+            f"slots={args.serve_slots}, decode {args.new_tokens} @ "
+            f"~{args.prompt_len} shared prompt, chunk="
+            f"{args.serve_chunk}, kernel OFF {tps_off:.0f} tok/s, "
+            f"shared-KV saved {saved_on} B "
+            f"[{saved_on / 2**20:.2f} MiB] (off leg {saved_off} B), "
+            f"peak group {stats_on['decode_group_peak']}, "
+            f"text unchanged={unchanged})",
+            "value": round(tps_on, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(tps_on / max(tps_off, 1e-9), 4),
+        },
+        args.out,
     )
     if not unchanged:
         print(
@@ -755,22 +812,153 @@ def _bench_fanout_prefix_ab(args, cfg, params, tokens, lengths) -> int:
         legs[name] = b * args.new_tokens / wall
     parity = bool(_np.array_equal(outs["on"], outs["off"]))
     n_chips = jax.device_count()
-    print(
-        json.dumps(
-            {
-                "metric": f"candidate-tokens/sec/chip, shared-prefix "
-                f"decode kernel ({cfg.name}, N={b}, decode "
-                f"{args.new_tokens} @ prompt {tokens.shape[1]}, "
-                f"kv={args.kv_quant}, kernel OFF "
-                f"{legs['off'] / n_chips:.0f} tok/s/chip, "
-                f"tokens equal={parity})",
-                "value": round(legs["on"] / n_chips, 2),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(legs["on"] / max(legs["off"], 1e-9), 4),
-            }
-        )
+    _emit(
+        {
+            "metric": f"candidate-tokens/sec/chip, shared-prefix "
+            f"decode kernel ({cfg.name}, N={b}, decode "
+            f"{args.new_tokens} @ prompt {tokens.shape[1]}, "
+            f"kv={args.kv_quant}, kernel OFF "
+            f"{legs['off'] / n_chips:.0f} tok/s/chip, "
+            f"tokens equal={parity})",
+            "value": round(legs["on"] / n_chips, 2),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(legs["on"] / max(legs["off"], 1e-9), 4),
+        },
+        args.out,
     )
     return 0 if parity else 1
+
+
+def _bench_serving_offload(args, cfg, params) -> int:
+    """Hierarchical-KV A/B: the multi-round panel shape over a starved
+    page pool, host offload tier on vs off.
+
+    Round 1 serves the panel burst (one shared header, unique
+    question tails); a filler round of unique-prefix requests then
+    forces registry eviction — with the tier ON the header pages
+    demote to host RAM, OFF they are destroyed; the re-vote round
+    re-sends the same header, which the ON leg RESTORES (device_put
+    between decode steps) and the OFF leg re-prefills. Reports
+    restored pages, prompt tokens the restores saved, per-page restore
+    latency, prefill-chunk counts for both legs, and the acceptance
+    contract: generated text byte-identical across legs.
+    """
+    from llm_consensus_tpu.server.metrics import KV_RESTORE_SECONDS
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    # Header covers >= 2 full pages even at small --prompt-len (full
+    # pages are the demote/restore unit), tails stay short.
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    header = f"Panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    n = args.serve_requests
+    # Filler round: prefixes unique from byte 0 (no cross-filler
+    # sharing) and padded into the HEADER's bucket, so concurrent
+    # filler admissions demand the whole starved pool and eviction
+    # must walk past the per-request tail leaves up into the header's
+    # chain (evict drops childless leaves first — short fillers would
+    # only ever shave the leaves and prove nothing).
+    filler_pad = "unrelated traffic padding " * (-(-header_target // 25))
+    rounds = [
+        [header + f"Q{i}: propose for item {i * 37 % 101}" for i in range(n)],
+        [f"{i} filler {salt}: " + filler_pad for i in range(n)],
+        [header + f"R{i}: re-vote on item {i * 37 % 101}" for i in range(n)],
+    ]
+    longest = max(len(p) for r in rounds for p in r) + 1
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    pages_per_seq = -(
+        -(buckets[-1] + args.new_tokens + args.serve_chunk - 1) // pg
+    )
+    # The point of the leg: the pool holds exactly the slots' unshared
+    # working set and NOTHING more, so cached prefixes cannot stay
+    # device-resident across rounds — eviction pressure is guaranteed.
+    n_pages = 1 + args.serve_slots * pages_per_seq
+
+    def run(host_cache_bytes: int):
+        batcher = ContinuousBatcher(
+            cfg,
+            params,
+            config=ContinuousConfig(
+                max_slots=args.serve_slots,
+                page_size=pg,
+                n_pages=n_pages,
+                pages_per_seq=pages_per_seq,
+                max_new_tokens=args.new_tokens,
+                seq_buckets=tuple(buckets),
+                steps_per_sync=args.serve_chunk,
+                prefill_chunk=args.serve_prefill_chunk or 64,
+                share_prefix=True,
+                host_cache_bytes=host_cache_bytes,
+            ),
+        )
+        try:
+            batcher.submit(
+                f"warmup {salt} " + "ctx " * (args.prompt_len // 5),
+                max_new_tokens=args.new_tokens,
+            ).result(timeout=600)
+            texts = []
+            t0 = time.perf_counter()
+            toks = 0
+            for burst in rounds:
+                futs = [
+                    batcher.submit(p, max_new_tokens=args.new_tokens)
+                    for p in burst
+                ]
+                results = [f.result(timeout=600) for f in futs]
+                texts.append([r.text for r in results])
+                toks += sum(r.num_tokens for r in results)
+            wall = time.perf_counter() - t0
+            stats = batcher.stats()
+        finally:
+            batcher.close()
+        return texts, toks / wall, stats
+
+    r_before = (KV_RESTORE_SECONDS.sum, KV_RESTORE_SECONDS.count)
+    texts_on, tps_on, s_on = run(args.serve_host_cache_mb << 20)
+    r_sum = KV_RESTORE_SECONDS.sum - r_before[0]
+    r_cnt = KV_RESTORE_SECONDS.count - r_before[1]
+    texts_off, tps_off, s_off = run(0)
+    unchanged = texts_on == texts_off
+    restored = s_on["offload_restored_pages"]
+    tokens_saved = restored * pg
+    restore_ms = 1e3 * r_sum / r_cnt if r_cnt else 0.0
+    _emit(
+        {
+            "metric": f"serving tok/s, hierarchical KV offload "
+            f"({cfg.name}, 3x{n} reqs, slots={args.serve_slots}, "
+            f"pool={n_pages} pages [working-set starved], host tier "
+            f"{args.serve_host_cache_mb} MiB, decode {args.new_tokens} "
+            f"@ ~{header_target} shared header, demoted "
+            f"{s_on['offload_demoted_pages']} / restored {restored} / "
+            f"dropped {s_on['offload_dropped_pages']} pages, prefill "
+            f"tokens saved {tokens_saved}, restore avg {restore_ms:.1f} "
+            f"ms/page, chunks ON {s_on['prefill_chunks']} vs OFF "
+            f"{s_off['prefill_chunks']}, tier-off {tps_off:.0f} tok/s, "
+            f"text unchanged={unchanged})",
+            "value": round(tps_on, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(tps_on / max(tps_off, 1e-9), 4),
+        },
+        args.out,
+    )
+    if not unchanged:
+        print(
+            "[bench] GENERATED TEXT DIVERGED between offload-on and "
+            "offload-off serving — restore regression",
+            file=sys.stderr,
+        )
+        return 1
+    # The leg exists to demonstrate restores: a run where nothing
+    # demoted+restored proves nothing (pool sizing regression).
+    return 0 if restored > 0 and tokens_saved > 0 else 1
 
 
 def _bench_serving(args, cfg, params) -> int:
@@ -889,23 +1077,22 @@ def _bench_serving(args, cfg, params) -> int:
             f"chunks={after['prefill_chunks'] - before['prefill_chunks']}, "
             f"stall avg {stall_ms:.1f} ms"
         )
-    print(
-        json.dumps(
-            {
-                "metric": f"serving requests/sec ({cfg.name}, "
-                f"{args.serve_requests} reqs, slots={args.serve_slots}, "
-                f"decode {args.new_tokens} @ ~{args.prompt_len} prompt"
-                + (" SHARED" if shared else "")
-                + f", chunk={args.serve_chunk}, "
-                f"prefill_chunk={args.serve_prefill_chunk}, "
-                f"paged pallas={cfg.use_pallas}, "
-                f"{n_tokens / wall:.0f} generated tok/s, "
-                f"{steps} decode steps{prefix_note})",
-                "value": round(rps, 2),
-                "unit": "requests/sec",
-                "vs_baseline": round(rps, 4),
-            }
-        )
+    _emit(
+        {
+            "metric": f"serving requests/sec ({cfg.name}, "
+            f"{args.serve_requests} reqs, slots={args.serve_slots}, "
+            f"decode {args.new_tokens} @ ~{args.prompt_len} prompt"
+            + (" SHARED" if shared else "")
+            + f", chunk={args.serve_chunk}, "
+            f"prefill_chunk={args.serve_prefill_chunk}, "
+            f"paged pallas={cfg.use_pallas}, "
+            f"{n_tokens / wall:.0f} generated tok/s, "
+            f"{steps} decode steps{prefix_note})",
+            "value": round(rps, 2),
+            "unit": "requests/sec",
+            "vs_baseline": round(rps, 4),
+        },
+        args.out,
     )
     return 0
 

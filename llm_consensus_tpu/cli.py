@@ -178,6 +178,7 @@ def _build_backend(args):
                 max_new_tokens=args.max_new_tokens,
                 prefill_chunk=args.prefill_chunk,
                 share_prefix=not args.no_share_prefix,
+                host_cache_bytes=args.host_cache_mb << 20,
             ),
             mesh=mesh,
         )
@@ -221,6 +222,15 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         action="store_true",
         help="continuous backend: disable copy-on-write shared-prefix "
         "page dedup",
+    )
+    p.add_argument(
+        "--host-cache-mb",
+        type=int,
+        default=0,
+        help="continuous backend: host-RAM KV offload tier budget in "
+        "MiB (0 = off) — evicted prefix-registry pages demote to host "
+        "buffers and restore at the next same-prefix admission instead "
+        "of re-prefilling",
     )
     p.add_argument(
         "--cpu",

@@ -1356,6 +1356,8 @@ def plan_memory(
     seq_buckets: tuple[int, ...] | None = None,
     batch_buckets: tuple[int, ...] | None = None,
     shared_prefix_len: int = 0,
+    host_cache_bytes: int = 0,
+    page_size: int = 64,
 ) -> dict:
     """Config-only HBM plan — no weights are ever allocated.
 
@@ -1375,6 +1377,15 @@ def plan_memory(
     shards over. ``shared_prefix_len``: prompt tokens stored once for
     the whole fan-out (the paged serving path's prefix sharing) — see
     :meth:`InferenceEngine.memory_estimate`.
+
+    ``host_cache_bytes`` > 0 adds the hierarchical-cache tier (PR 4,
+    ``ContinuousConfig.host_cache_bytes``) to the plan: how many
+    ``page_size``-token KV pages — in this config's KV dtype,
+    ``kv_quant`` scales included — the host-RAM tier can keep warm,
+    and the prefix-token capacity that buys. Host bytes never count
+    against ``hbm_bytes`` (pinned host RAM, not device memory); the
+    tier changes how much RECOMPUTE eviction costs, not whether the
+    device footprint fits.
     """
     from llm_consensus_tpu.models.transformer import init_params
     from llm_consensus_tpu.ops.quant import quantize_params, quantized_bytes
@@ -1424,6 +1435,16 @@ def plan_memory(
         "batch": b,
         "cache_len": cache_len,
     }
+    if host_cache_bytes > 0:
+        # One page of KV in this config's dtype, scales included — the
+        # same _kv_cache_bytes formula the device terms use, so a cache
+        # layout change cannot drift the two tiers apart.
+        page_bytes = _kv_cache_bytes(cfg, 1, page_size, kv_quant)
+        host_pages = host_cache_bytes // max(1, page_bytes)
+        out["host_cache_bytes"] = host_cache_bytes
+        out["host_page_bytes"] = page_bytes
+        out["host_capacity_pages"] = host_pages
+        out["host_capacity_tokens"] = host_pages * page_size
     if hbm_bytes is not None:
         out["fits"] = total <= hbm_bytes
     return out

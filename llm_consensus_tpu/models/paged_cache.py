@@ -206,6 +206,27 @@ def copy_page(
     )
 
 
+def install_page(
+    cache: PagedKVCache,
+    page: jnp.ndarray,
+    k_page: jnp.ndarray,  # [L, page_size, Hkv, Dh]
+    v_page: jnp.ndarray,
+) -> PagedKVCache:
+    """Write one page's K/V across all layers from host-side planes.
+
+    The offload tier's promote primitive
+    (:mod:`llm_consensus_tpu.serving.offload`): a page demoted to host
+    RAM comes back through this op verbatim — same dtype, same bytes —
+    so a restored prefix is indistinguishable from one that never left
+    the pool.
+    """
+    k = cache.k.at[:, page].set(k_page.astype(cache.k.dtype))
+    v = cache.v.at[:, page].set(v_page.astype(cache.v.dtype))
+    return PagedKVCache(
+        k=k, v=v, page_table=cache.page_table, length=cache.length
+    )
+
+
 # ---------------------------------------------------------------------------
 # Host-side allocation: refcounted pages + prefix radix tree
 # ---------------------------------------------------------------------------
@@ -326,6 +347,13 @@ class PrefixRegistry:
         self.pages_shared = 0
         self.pages_copied = 0
         self.evictions = 0
+        # Offload tier (PR 4): called ONCE per evict() walk with the
+        # list of READY victim nodes, turning eviction from destruction
+        # into demotion — the callback spills the pages' content to
+        # host RAM keyed by :meth:`chain_tokens`, in one batched host
+        # transfer (a per-victim hook would stall admission on N
+        # sequential device_gets). None = plain eviction.
+        self.on_evict = None
 
     def __len__(self) -> int:
         return self._nodes
@@ -335,12 +363,28 @@ class PrefixRegistry:
         return self._nodes
 
     def reclaimable_pages(self) -> int:
-        """Registry pages held by nobody else — freeable via evict()."""
-        return sum(
-            1
-            for node in self._walk()
-            if self.pool.refcount(node.page) == 1
-        )
+        """Registry pages held by nobody else AND actually freeable via
+        :meth:`evict`.
+
+        evict() only ever drops leaves, so an interior node's page is
+        reclaimable only when its whole subtree is: a registry-only
+        parent above a child some live sequence still maps (refcount
+        > 1) can never be reached by eviction and must not be counted —
+        counting every refcount-1 node would overstate free capacity
+        and break the pool invariant ``available + pinned + reclaimable
+        == total`` (evict(∞) frees exactly this number; tested).
+        """
+
+        def subtree(node: _PrefixNode) -> tuple[int, bool]:
+            total, children_ok = 0, True
+            for child in node.children.values():
+                n, ok = subtree(child)
+                total += n
+                children_ok = children_ok and ok
+            ok = children_ok and self.pool.refcount(node.page) == 1
+            return total + (1 if ok else 0), ok
+
+        return sum(subtree(c)[0] for c in self._root.children.values())
 
     def _walk(self):
         stack = list(self._root.children.values())
@@ -460,6 +504,20 @@ class PrefixRegistry:
     def mark_ready(node: _PrefixNode) -> None:
         node.ready = True
 
+    @staticmethod
+    def chain_tokens(node: _PrefixNode) -> tuple[int, ...]:
+        """Every token from the prefix root through ``node``'s page —
+        the offload tier's key. A page's K/V content is a function of
+        the WHOLE token chain above it (attention reads every earlier
+        position), so the page run alone is not a sound identity; the
+        full chain is.
+        """
+        runs: list[tuple[int, ...]] = []
+        while node is not None and node.parent is not None:
+            runs.append(node.tokens)
+            node = node.parent
+        return tuple(t for run in reversed(runs) for t in run)
+
     def evict(self, n_pages: int) -> int:
         """Free up to ``n_pages`` registry-only pages (LRU leaves first).
 
@@ -469,6 +527,15 @@ class PrefixRegistry:
         the batcher's admission lock): eligible leaves are collected
         once into an LRU heap, and a parent enters the heap only when
         evicting its last child exposes it. Returns pages freed.
+
+        With :attr:`on_evict` set (the offload tier), the READY victims
+        are offered to the callback — once, as a batch — before evict()
+        returns: demotion, not destruction. Their pages are back on the
+        free list by then, but nothing re-WRITES a page until a later
+        alloc+prefill/copy enqueues work, and the callback completes
+        its host fetch synchronously first. Unready victims — their
+        prefill/restore never completed — hold garbage and are dropped
+        without a callback.
         """
         import heapq
 
@@ -479,9 +546,12 @@ class PrefixRegistry:
         ]
         heapq.heapify(heap)
         freed = 0
+        demote: list[_PrefixNode] = []
         while heap and freed < n_pages:
             _, _, victim = heapq.heappop(heap)
             parent = victim.parent
+            if self.on_evict is not None and victim.ready:
+                demote.append(victim)
             del parent.children[victim.tokens]
             self.pool.release(victim.page)
             self._nodes -= 1
@@ -493,6 +563,10 @@ class PrefixRegistry:
                 and self.pool.refcount(parent.page) == 1
             ):
                 heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        if demote:
+            # Unlinked nodes keep their parent/tokens attrs, so
+            # chain_tokens still resolves the full key here.
+            self.on_evict(demote)
         return freed
 
 

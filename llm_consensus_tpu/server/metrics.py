@@ -39,6 +39,11 @@ __all__ = [
     "PREFILL_STALL_SECONDS",
     "SHARED_KV_BYTES_SAVED",
     "DECODE_GROUP_SIZE",
+    "KV_OFFLOAD_DEMOTED",
+    "KV_OFFLOAD_RESTORED",
+    "KV_OFFLOAD_DROPPED",
+    "KV_RESTORE_SECONDS",
+    "KV_HOST_TIER_BYTES",
 ]
 
 # Seconds: spans ~1 ms .. 2 min, the TTFT / request-latency range of a
@@ -365,4 +370,36 @@ SHARED_KV_BYTES_SAVED = REGISTRY.counter(
 DECODE_GROUP_SIZE = REGISTRY.gauge(
     "gateway_decode_group_size",
     "Largest shared-prefix decode group at the last decode step",
+)
+#: Hierarchical KV cache (PR 4): the host-RAM tier under the prefix
+#: registry. Eviction DEMOTES registry-only prefix pages to pinned host
+#: buffers instead of dropping them; a later same-prefix admission
+#: RESTORES them (async device_put between decode steps) instead of
+#: re-prefilling; host-budget overflow DROPS the LRU page (the tier
+#: below host RAM is recompute).
+KV_OFFLOAD_DEMOTED = REGISTRY.counter(
+    "gateway_kv_offload_demoted_pages_total",
+    "Prefix-registry pages demoted to the host-RAM KV tier on eviction",
+)
+KV_OFFLOAD_RESTORED = REGISTRY.counter(
+    "gateway_kv_offload_restored_pages_total",
+    "Host-tier KV pages restored to the device pool at admission",
+)
+KV_OFFLOAD_DROPPED = REGISTRY.counter(
+    "gateway_kv_offload_dropped_pages_total",
+    "Host-tier KV pages dropped (LRU under the byte budget, or oversize)",
+)
+#: Host→device promotion latency per page, install included — the
+#: number that must beat re-prefilling page_size tokens for the tier to
+#: pay for itself.
+KV_RESTORE_SECONDS = REGISTRY.histogram(
+    "gateway_kv_restore_seconds",
+    "Per-page host-to-device KV restore latency (device_put + install)",
+    buckets=LATENCY_BUCKETS,
+)
+#: Host-tier occupancy (bytes resident right now, vs the configured
+#: ContinuousConfig.host_cache_bytes budget).
+KV_HOST_TIER_BYTES = REGISTRY.gauge(
+    "gateway_kv_host_tier_bytes",
+    "Bytes resident in the host-RAM KV offload tier",
 )

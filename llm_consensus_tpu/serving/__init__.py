@@ -18,6 +18,7 @@ from llm_consensus_tpu.serving.continuous import (
     ContinuousConfig,
     ServeResult,
 )
+from llm_consensus_tpu.serving.offload import HostPageStore
 from llm_consensus_tpu.serving.scheduler import (
     BatchScheduler,
     SchedulerConfig,
@@ -29,6 +30,7 @@ __all__ = [
     "ContinuousBackend",
     "ContinuousBatcher",
     "ContinuousConfig",
+    "HostPageStore",
     "SchedulerConfig",
     "ServeResult",
     "ServingBackend",

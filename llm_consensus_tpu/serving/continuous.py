@@ -30,8 +30,18 @@ style, re-founded on XLA's compile-once constraint:
   happens at admission, gated by per-page readiness flags, so a burst
   of same-prefix requests dedups against the first request's in-flight
   prefill instead of racing it.
+- **Host-RAM offload tier** (PR 4, :mod:`llm_consensus_tpu.serving.
+  offload`): with ``host_cache_bytes > 0``, prefix-registry eviction
+  DEMOTES ready pages to a byte-budgeted host LRU store instead of
+  dropping them, and admission falls through registry-miss → host-hit,
+  restoring pages via ``device_put`` + install scheduled between
+  decode steps exactly like prefill chunks. Restored pages re-register
+  under the same per-page readiness gates, so a same-prefix burst
+  dedups against an in-flight restore like an in-flight prefill — and
+  a restored prefix is byte-identical to a re-prefilled one (tested).
 - A host thread drives: admit waiting requests into free slots, run at
-  most one prefill chunk, run one decode step for all slots, sample,
+  most one restore or prefill chunk, run one decode step for all
+  slots, sample,
   retire EOS/length-capped slots, resolve futures. Inactive slots decode
   into the reserved NULL page and their outputs are discarded (the cost
   of a dead slot is one row of an already-batched matmul — negligible
@@ -83,10 +93,12 @@ from llm_consensus_tpu.models.paged_cache import (
     PrefixRegistry,
     assign_pages,
     copy_page,
+    install_page,
     install_seq,
     release_seq,
     write_prefill_kv,
 )
+from llm_consensus_tpu.serving.offload import HostPageStore
 from llm_consensus_tpu.models.transformer import (
     decode_step_paged,
     prefill,
@@ -113,6 +125,21 @@ from llm_consensus_tpu.server.metrics import (
 )
 from llm_consensus_tpu.server.metrics import (
     SHARED_KV_BYTES_SAVED as _M_KV_SAVED,
+)
+from llm_consensus_tpu.server.metrics import (
+    KV_OFFLOAD_DEMOTED as _M_OFF_DEMOTED,
+)
+from llm_consensus_tpu.server.metrics import (
+    KV_OFFLOAD_DROPPED as _M_OFF_DROPPED,
+)
+from llm_consensus_tpu.server.metrics import (
+    KV_OFFLOAD_RESTORED as _M_OFF_RESTORED,
+)
+from llm_consensus_tpu.server.metrics import (
+    KV_HOST_TIER_BYTES as _M_OFF_HOST_BYTES,
+)
+from llm_consensus_tpu.server.metrics import (
+    KV_RESTORE_SECONDS as _M_RESTORE_SECONDS,
 )
 from llm_consensus_tpu.server.metrics import REGISTRY as _REG
 
@@ -191,6 +218,15 @@ class ContinuousConfig:
     # runs, outputs identical. Off = always the plain kernel (the
     # bench's A/B baseline).
     prefix_attention: bool = True
+    # Host-RAM offload tier under the prefix registry (PR 4): byte
+    # budget for demoted KV pages. > 0: registry eviction DEMOTES
+    # ready prefix pages to host buffers instead of dropping them, and
+    # admission falls through registry-miss -> host-hit, restoring
+    # pages via device_put interleaved with decode steps. 0 (default):
+    # eviction destroys, exactly the PR 2/3 behavior. Requires
+    # share_prefix + prefill_chunk > 0 (the restore path re-registers
+    # pages under the registry's readiness gates).
+    host_cache_bytes: int = 0
 
 
 @dataclass
@@ -320,6 +356,29 @@ class ContinuousBatcher:
         self._registries = [
             PrefixRegistry(pool, c.page_size) for pool in self._pools
         ]
+        # Host-RAM offload tier (PR 4). Engages only on the chunked
+        # shared-prefix path (restores re-register under the registry's
+        # readiness gates) and off-mesh: a sharded pool's page planes
+        # would device_get/install across the data axis, a transfer
+        # pattern nothing exercises yet — the documented fallback is
+        # plain eviction, exactly the PR 2/3 behavior (README Serving).
+        self._offload: HostPageStore | None = None
+        if (
+            c.host_cache_bytes > 0
+            and c.share_prefix
+            and c.prefill_chunk > 0
+            and mesh is None
+        ):
+            self._offload = HostPageStore(c.host_cache_bytes)
+            for reg in self._registries:
+                reg.on_evict = self._demote_nodes
+        # Pending page restores: (registry node, host planes). Filled at
+        # admission, drained one page per loop iteration between decode
+        # steps (the same bounded-stall discipline as prefill chunks);
+        # the node's readiness gate holds dependent prefills until the
+        # install lands.
+        self._restores: deque = deque()
+        self._offload_restored = 0
         # Group-aware decode attention: derive per-step groups from
         # shared prefix page runs. Engages only where the grouped
         # Pallas kernel can run (single device, no sliding window, the
@@ -371,6 +430,7 @@ class ContinuousBatcher:
         self._jit_prefill = {}
         self._jit_chunk = {}  # (chunk, s_bucket) -> compiled chunk prefill
         self._jit_copy_page = jax.jit(copy_page, donate_argnums=(0,))
+        self._jit_install_page = jax.jit(install_page, donate_argnums=(0,))
         self._jit_unembed = jax.jit(partial(unembed_one, self.cfg))
         # Round-robin pointer over prefilling slots (fairness when
         # several prompts fill concurrently).
@@ -581,6 +641,26 @@ class ContinuousBatcher:
                 "shared_kv_bytes_saved": self._kv_bytes_saved,
                 "decode_group_size": self._groups.largest_group,
                 "decode_group_peak": self._groups.peak_group,
+                # Host-RAM offload tier (PR 4). Demoted counts every
+                # eviction that reached the host store (including
+                # refreshes of already-spilled chains); restored counts
+                # pages promoted back instead of re-prefilled — each
+                # one is page_size prompt tokens the chip never
+                # recomputed; dropped is LRU pressure within the host
+                # budget.
+                "offload_demoted_pages": (
+                    self._offload.demoted_pages if self._offload else 0
+                ),
+                "offload_restored_pages": self._offload_restored,
+                "offload_dropped_pages": (
+                    self._offload.dropped_pages if self._offload else 0
+                ),
+                "offload_host_bytes": (
+                    self._offload.bytes_used if self._offload else 0
+                ),
+                "offload_host_pages": (
+                    len(self._offload) if self._offload else 0
+                ),
             }
 
     def close(self) -> None:
@@ -703,6 +783,7 @@ class ContinuousBatcher:
         c = self.config
         ids = req.prompt_ids
         L = len(ids)
+        pg = c.page_size
         bucket = self._bucket(L)
         chunk = self._chunk_width(bucket)
 
@@ -730,6 +811,7 @@ class ContinuousBatcher:
                 shared_pages: list[int] = []
                 start0 = 0
                 boundary = 0
+                restore_plan: list = []
                 if use_share:
                     # Boundary copies must beat recompute: a whole-page
                     # device copy for a trivial overlap (every prompt
@@ -742,9 +824,41 @@ class ContinuousBatcher:
                     start0 = match.shared_tokens
                     if match.boundary_page is not None:
                         boundary = match.boundary_common
-                    if not shared_pages and not boundary:
+                    # Fall through registry-miss -> host-hit (PR 4):
+                    # extend the matched chain through pages the
+                    # offload tier still holds. Each hit is page_size
+                    # prompt tokens promoted back by a device_put
+                    # instead of recomputed; full-page restores
+                    # supersede the partial boundary copy (their
+                    # ranges would overlap).
+                    if self._offload is not None:
+                        k = start0 // pg
+                        usable_full = (L - 1) // pg
+                        if k < usable_full:
+                            # One int conversion for the whole probe
+                            # range; per-page keys are O(1) slices of
+                            # it, not per-iteration re-tuplings.
+                            chain = tuple(
+                                int(t) for t in ids[: usable_full * pg]
+                            )
+                        while k < usable_full:
+                            planes = self._offload.get(chain[: (k + 1) * pg])
+                            if planes is None:
+                                break
+                            restore_plan.append(planes)
+                            k += 1
+                        if restore_plan:
+                            # Full-page restores supersede the partial
+                            # boundary ON THE MATCH TOO: record_commit
+                            # reads match.boundary_common, and the
+                            # stats()/Prometheus hit counters must
+                            # agree (PR 2 contract).
+                            boundary = 0
+                            match.boundary_page = None
+                            match.boundary_common = 0
+                    if not shared_pages and not boundary and not restore_plan:
                         continue  # registry miss: plan B is identical
-                start = start0 + boundary
+                start = start0 + len(restore_plan) * pg + boundary
                 end = start + -(-(L - start) // chunk) * chunk
                 total = self._table_pages(bucket, end, req)
                 need_new = total - len(shared_pages)
@@ -765,7 +879,12 @@ class ContinuousBatcher:
                     continue
                 if use_share:
                     registry.record_commit(match, copied=bool(boundary))
-                    _M_PREFIX_HITS.inc()
+                    if shared_pages or boundary:
+                        # record_commit's definition of a hit: a pure
+                        # host-tier restore is counted by the offload
+                        # families, not the registry's — the two
+                        # surfaces must agree (PR 2 contract).
+                        _M_PREFIX_HITS.inc()
                     _M_PREFIX_SHARED.inc(len(shared_pages))
                 new_pages = pool.alloc(need_new)
                 pages = shared_pages + new_pages
@@ -789,9 +908,28 @@ class ContinuousBatcher:
                 reg_nodes = (
                     registry.register(ids, pages) if c.share_prefix else []
                 )
+                restore_nodes: list = []
+                if restore_plan:
+                    # Pages the host tier is about to repopulate:
+                    # register() just created their nodes (the match
+                    # walk stopped exactly where the tree thinned out),
+                    # unready until the install lands. They leave
+                    # reg_nodes — THIS prefill starts past them and
+                    # never writes them — and gate both our own first
+                    # chunk and any same-prefix burst-mate, exactly
+                    # like an in-flight prefill.
+                    restore_nodes = [
+                        n for n, end_pos in reg_nodes if end_pos <= start
+                    ]
+                    reg_nodes = [
+                        (n, e) for n, e in reg_nodes if e > start
+                    ]
+                    assert len(restore_nodes) == len(restore_plan)
+                    for node, planes in zip(restore_nodes, restore_plan):
+                        self._restores.append((node, planes))
                 padded = np.full((end,), self.tokenizer.pad_id, np.int32)
                 padded[:L] = ids
-                deps = [
+                deps = restore_nodes + [
                     n
                     for n in (match.nodes if match else [])
                     if not n.ready
@@ -812,6 +950,80 @@ class ContinuousBatcher:
                 )
                 return True
         return False
+
+    def _demote_nodes(self, nodes) -> None:
+        """PrefixRegistry.on_evict hook: spill an evict() walk's ready
+        victims to the host tier instead of losing them (worker thread,
+        inside the admission lock — the one place evictions happen).
+
+        ONE batched device_get covers every page the store doesn't
+        already hold — an eviction burst costs one host transfer, not
+        N sequential round trips stalling the decode loop. Chains that
+        round-tripped before skip the fetch entirely (recency refresh
+        only). The Prometheus families move by the STORE's own deltas,
+        so a put() the budget refuses (oversize page) never counts as
+        a demotion on either surface.
+        """
+        store = self._offload
+        demoted0 = store.demoted_pages
+        dropped0 = store.dropped_pages
+        fetch: list[tuple[tuple, int]] = []
+        for node in nodes:
+            key = PrefixRegistry.chain_tokens(node)
+            if key in store:
+                store.touch(key)
+            else:
+                fetch.append((key, node.page))
+        if fetch:
+            pages = jnp.asarray([p for _, p in fetch], jnp.int32)
+            ks, vs = jax.device_get(
+                (self.cache.k[:, pages], self.cache.v[:, pages])
+            )  # [L, n, page, Hkv, Dh]
+            for i, (key, _) in enumerate(fetch):
+                # Contiguous copies: a view into the batch buffer would
+                # pin the whole [L, n, ...] fetch alive in the store.
+                store.put(
+                    key,
+                    (
+                        np.ascontiguousarray(ks[:, i]),
+                        np.ascontiguousarray(vs[:, i]),
+                    ),
+                )
+        _M_OFF_DEMOTED.inc(store.demoted_pages - demoted0)
+        _M_OFF_DROPPED.inc(store.dropped_pages - dropped0)
+        _M_OFF_HOST_BYTES.set(store.bytes_used)
+
+    def _restore_step(self) -> bool:
+        """Promote ONE host-tier page back into the device pool.
+
+        The restore counterpart of :meth:`_prefill_step`: at most one
+        page's ``device_put`` + install runs between decode steps, so
+        running slots pay a bounded, page-sized stall — and the
+        readiness flip afterwards releases every admission gated on
+        this page (the admitting slot's first chunk, plus any
+        same-prefix burst-mate that deduped against the in-flight
+        restore). Returns True when a page was restored.
+        """
+        if not self._restores:
+            return False
+        node, planes = self._restores.popleft()
+        t0 = time.perf_counter()
+        self.cache = self._jit_install_page(
+            self.cache,
+            jnp.int32(node.page),
+            jnp.asarray(planes[0]),
+            jnp.asarray(planes[1]),
+        )
+        # The install must COMPLETE before readers are released (same
+        # contract as a prefill chunk's block) — and the histogram's
+        # point is the true host->device promotion latency.
+        jax.block_until_ready(self.cache.length)
+        _M_RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        node.ready = True
+        _M_OFF_RESTORED.inc()
+        with self._lock:
+            self._offload_restored += 1
+        return True
 
     def _prefill_step(self) -> bool:
         """Run ONE prefill chunk for one ready prefilling slot.
@@ -1136,10 +1348,13 @@ class ContinuousBatcher:
         while not self._stop.is_set():
             self._admit()
             progress = False
-            # At most ONE prefill chunk between decode steps: running
-            # slots pay a bounded, chunk-sized stall per admission
-            # instead of a whole prompt's prefill.
-            if self.config.prefill_chunk > 0 and self._prefill_step():
+            # At most ONE prefill work unit between decode steps —
+            # a host-tier page restore (which unblocks gated prefills)
+            # or a prefill chunk: running slots pay a bounded stall per
+            # admission instead of a whole prompt's prefill.
+            if self.config.prefill_chunk > 0 and (
+                self._restore_step() or self._prefill_step()
+            ):
                 progress = True
             if self._decoding():
                 self._step()

@@ -1,0 +1,145 @@
+"""Host-RAM offload tier under the paged prefix registry (PR 4).
+
+PRs 2-3 made the consensus panel's shared prompt prefix free in HBM
+capacity (CoW page sharing) and decode bandwidth (group-aware
+attention) — but only while the pages stay resident:
+:meth:`~llm_consensus_tpu.models.paged_cache.PrefixRegistry.evict`
+permanently dropped registry-only pages under pool pressure, so the
+protocol's multi-round traffic (propose → evaluate → refine, each round
+re-sending the same huge header) re-prefilled prefixes the chip
+computed minutes ago. This module turns that eviction into DEMOTION:
+
+- **Demote** — the registry's ``on_evict`` hook hands each ready victim
+  page to the batcher, which ``jax.device_get``s its K/V planes into
+  this byte-budgeted :class:`HostPageStore`. Pages spill VERBATIM in
+  the pool's own dtype (an int8-KV pool's quantized pages travel with
+  whatever scale planes the caller passes) — no recompression, so a
+  restored page is bit-identical to the one that left.
+- **Restore** — admission falls through registry-miss → host-hit: the
+  matched chain extends through host-resident pages, which are
+  allocated fresh device pages, re-registered (ready=False), and
+  promoted back via async ``device_put`` + ``install_page`` scheduled
+  BETWEEN decode steps, exactly like chunked prefill. The per-page
+  readiness gates PR 2 built make a same-prefix burst dedup against an
+  in-flight *restore* the same way it dedups against an in-flight
+  prefill.
+
+Keys are full token CHAINS (every token from the prefix root through
+the page's end), not per-page runs: a page's K/V content is a function
+of its whole context, so the chain is the only sound identity. The
+store is a plain LRU over ``budget_bytes`` — overflow drops the
+least-recently-used page cleanly (the tier below host RAM is
+recompute, which is always correct).
+
+Host-side only and jax-free on the hot paths (plain numpy + an
+OrderedDict); the batcher owns the device transfers. Not thread-safe —
+the continuous batcher's worker owns it, like the pools/registries.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["HostPageStore", "page_planes"]
+
+#: A host-resident page: one numpy array per cache plane (k, v, and for
+#: quantized pools their scale planes), stored verbatim.
+Planes = tuple
+
+
+class HostPageStore:
+    """Byte-budgeted LRU store of demoted KV pages, keyed by token chain.
+
+    ``put`` accepts a tuple of numpy planes and accounts their exact
+    ``nbytes``; when the budget overflows, least-recently-used entries
+    drop (counted in :attr:`dropped_pages` — the tier below host RAM is
+    recompute). ``get`` returns the planes verbatim and refreshes
+    recency; entries SURVIVE a restore, so a prefix that round-trips
+    HBM → host → HBM → evicted again re-demotes without a second
+    device fetch (:meth:`contains` lets the demote hook skip the
+    ``device_get``).
+    """
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError(f"budget_bytes must be > 0, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self._entries: "OrderedDict[tuple, Planes]" = OrderedDict()
+        self._bytes = 0
+        # Monotonic counters (the serving layer exports these).
+        self.demoted_pages = 0
+        self.dropped_pages = 0
+        self.lookups = 0
+        self.hits = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self._entries
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    @staticmethod
+    def _nbytes(planes: Planes) -> int:
+        return sum(int(p.nbytes) for p in planes)
+
+    def put(self, key: tuple, planes: Sequence[np.ndarray]) -> bool:
+        """Demote one page's planes. Returns True when resident after
+        the call (a page bigger than the whole budget is refused — it
+        could only live by evicting everything for one entry)."""
+        planes = tuple(np.asarray(p) for p in planes)
+        if key in self._entries:
+            # Same chain => same content (KV is a deterministic function
+            # of the chain); refresh recency, keep the original bytes.
+            self._entries.move_to_end(key)
+            self.demoted_pages += 1
+            return True
+        nbytes = self._nbytes(planes)
+        if nbytes > self.budget_bytes:
+            self.dropped_pages += 1
+            return False
+        self._entries[key] = planes
+        self._bytes += nbytes
+        self.demoted_pages += 1
+        while self._bytes > self.budget_bytes:
+            _, victim = self._entries.popitem(last=False)
+            self._bytes -= self._nbytes(victim)
+            self.dropped_pages += 1
+        return True
+
+    def touch(self, key: tuple) -> None:
+        """Re-demotion of a chain already resident: same chain => same
+        content, so only recency moves — no second device fetch, no
+        byte-accounting change (the demote hook checks ``in`` first)."""
+        self._entries.move_to_end(key)
+        self.demoted_pages += 1
+
+    def get(self, key: tuple) -> Planes | None:
+        """Planes for ``key`` (verbatim), refreshing recency; None on
+        miss. The entry stays resident — restore does not consume it."""
+        self.lookups += 1
+        planes = self._entries.get(key)
+        if planes is None:
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return planes
+
+
+def page_planes(cache, page: int) -> tuple[np.ndarray, np.ndarray]:
+    """Fetch one page's (k, v) planes to host, verbatim dtype.
+
+    One blocking ``device_get`` ([L, page, Hkv, Dh] each — a 1B-class
+    config at page 64 is ~1.5 MiB bf16). The single-page primitive for
+    tests and tools; the batcher's demote hook batches an evict walk's
+    victims into ONE device_get instead of calling this per page.
+    """
+    import jax
+
+    return jax.device_get((cache.k[:, page], cache.v[:, page]))

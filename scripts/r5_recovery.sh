@@ -77,7 +77,9 @@ if [ ! -e runs/arith3m/DONE ]; then
 fi
 leg runs/reports/spec_trained_r5.json bash -c \
   'python examples/spec_arith_demo.py --target-ckpt runs/arith14m \
-     --draft-ckpt runs/arith3m > runs/reports/spec_trained_r5.json'
+     --draft-ckpt runs/arith3m > runs/reports/spec_trained_r5.json.tmp \
+   && mv runs/reports/spec_trained_r5.json.tmp \
+         runs/reports/spec_trained_r5.json'
 
 # Q3: arith2 hard-corpus training + 200-problem EM at natural temp.
 if [ ! -e runs/arith25m/DONE ]; then
@@ -94,37 +96,42 @@ leg runs/reports/arith25m_em_arith2_r5.json \
 leg runs/reports/panel_config3_r5.json bash -c \
   'python examples/panel_arith_demo.py --ckpt runs/arith14m \
      --ckpt runs/arith14m_mid2 --ckpt runs/arith14m_mid \
-     > runs/reports/panel_config3_r5.json'
+     > runs/reports/panel_config3_r5.json.tmp \
+   && mv runs/reports/panel_config3_r5.json.tmp \
+         runs/reports/panel_config3_r5.json'
 leg runs/reports/debate_arith_r5.json \
   python examples/debate_arith_eval.py --ckpt runs/arith14m \
     --report runs/reports/debate_arith_r5.json
 
-# Q5: bench legs (PERF.md pending rows).
-leg runs/r5_bench_serve3.json bash -c \
-  'python bench.py --serve --serve-chunk 16 | tail -1 > runs/r5_bench_serve3.json'
-leg runs/r5_bench_moe_auto.json bash -c \
-  'python bench.py --model moe-1b-4e | tail -1 > runs/r5_bench_moe_auto.json'
-leg runs/r5_bench_moe_dense.json bash -c \
-  'python bench.py --model moe-1b-4e --moe-dense | tail -1 > runs/r5_bench_moe_dense.json'
-leg runs/r5_bench_moe_pinned.json bash -c \
-  'python bench.py --model moe-1b-4e --moe-capacity | tail -1 > runs/r5_bench_moe_pinned.json'
-leg runs/r5_bench_spec_self2.json bash -c \
-  'python bench.py --draft self --n-candidates 8 | tail -1 > runs/r5_bench_spec_self2.json'
-leg runs/r5_bench_default_a.json bash -c \
-  'python bench.py | tail -1 > runs/r5_bench_default_a.json'
-leg runs/r5_bench_default_b.json bash -c \
-  'python bench.py | tail -1 > runs/r5_bench_default_b.json'
+# Q5: bench legs (PERF.md pending rows). Artifacts land via bench's
+# atomic --out (tmp + os.replace) — shell redirection committed a torn
+# 0-byte spec_trained_r5.json when the container recycled mid-write
+# (VERDICT.md), so no leg writes its artifact through `>` anymore.
+leg runs/r5_bench_serve3.json \
+  python bench.py --serve --serve-chunk 16 --out runs/r5_bench_serve3.json
+leg runs/r5_bench_moe_auto.json \
+  python bench.py --model moe-1b-4e --out runs/r5_bench_moe_auto.json
+leg runs/r5_bench_moe_dense.json \
+  python bench.py --model moe-1b-4e --moe-dense --out runs/r5_bench_moe_dense.json
+leg runs/r5_bench_moe_pinned.json \
+  python bench.py --model moe-1b-4e --moe-capacity --out runs/r5_bench_moe_pinned.json
+leg runs/r5_bench_spec_self2.json \
+  python bench.py --draft self --n-candidates 8 --out runs/r5_bench_spec_self2.json
+leg runs/r5_bench_default_a.json \
+  python bench.py --out runs/r5_bench_default_a.json
+leg runs/r5_bench_default_b.json \
+  python bench.py --out runs/r5_bench_default_b.json
 
 # Q7: candidate-count scaling under the post-fix methodology.
 for N in 16 128 256 512 1024; do
-  leg "runs/r5_bench_scale_n$N.json" bash -c \
-    "python bench.py --n-candidates $N | tail -1 > runs/r5_bench_scale_n$N.json"
+  leg "runs/r5_bench_scale_n$N.json" \
+    python bench.py --n-candidates "$N" --out "runs/r5_bench_scale_n$N.json"
 done
 
 echo RECOVERY-ALL-DONE "$(date -u)"
 # Appended: exact-N legs for BASELINE configs[2] and [4].
-leg runs/r5_bench_moe_n16.json bash -c \
-  'python bench.py --model moe-1b-4e --n-candidates 16 | tail -1 > runs/r5_bench_moe_n16.json'
+leg runs/r5_bench_moe_n16.json \
+  python bench.py --model moe-1b-4e --n-candidates 16 --out runs/r5_bench_moe_n16.json
 leg runs/reports/debate_arith_n32_r5.json \
   python examples/debate_arith_eval.py --ckpt runs/arith14m \
     --n-candidates 32 --report runs/reports/debate_arith_n32_r5.json

@@ -408,6 +408,91 @@ def test_batcher_grouped_boundary_page_and_shrinking_group():
     assert stats["decode_group_peak"] >= 2
 
 
+def test_grouped_attention_survives_host_round_trip():
+    """GroupTracker × offload (PR 4): a decode group's shared prefix
+    cannot be demoted out from under its ACTIVE members (eviction skips
+    pages live tables hold — a filler storm during grouped decode must
+    leave the group's output untouched), and once the members retire
+    and the header DOES demote to host, the next same-header burst
+    restores it under fresh page ids and the group RE-FORMS over them
+    — text byte-identical to the ungrouped, offload-off path
+    throughout."""
+    params = _params()
+    # Tails stay short: header (49 chars) + tail must fit the largest
+    # bucket (64) or truncation cuts the header off the front.
+    group_prompts = [_HEADER + f"p{i} talks" for i in range(3)]
+    fillers = [
+        f"{i} unique filler storm prompt with enough padding text."
+        for i in range(6)
+    ]
+    # Second wave AFTER the group retires: only then is the header
+    # registry-only (refcount 1) and actually evictable — four of
+    # these concurrently demand the whole 20-page pool, forcing the
+    # header's chain to demote before the re-vote.
+    fillers2 = [
+        f"{i} second wave filler with just as much padding text."
+        for i in range(6, 12)
+    ]
+    revote = [_HEADER + f"r{i} votes" for i in range(3)]
+    # Starved pool: 20 usable pages vs a peak concurrent demand of ~17
+    # (3 grouped members + 1 filler slot), so the filler storm must
+    # recycle registry pages while the group decodes.
+    kw = dict(
+        max_slots=4,
+        page_size=16,
+        n_pages=21,
+        pages_per_seq=8,
+        max_new_tokens=4,
+        seq_buckets=(16, 32, 64),
+        prefill_chunk=16,
+        share_prefix=True,
+    )
+
+    def run(cfg, prefix_attention, host_cache_bytes):
+        b = ContinuousBatcher(
+            cfg, params,
+            config=ContinuousConfig(
+                **kw,
+                prefix_attention=prefix_attention,
+                host_cache_bytes=host_cache_bytes,
+            ),
+        )
+        try:
+            # Group decodes (mnt 20) WHILE the filler storm churns the
+            # pool through the one remaining slot.
+            gf = [b.submit(p, max_new_tokens=20) for p in group_prompts]
+            ff = [b.submit(p, max_new_tokens=4) for p in fillers]
+            texts = [f.result(timeout=120).text for f in gf + ff]
+            texts += [
+                r.text for r in _serve(b, fillers2, max_new_tokens=4)
+            ]
+            mid = b.stats()
+            if prefix_attention:
+                # Scope the lifetime peak to the re-vote round: the
+                # worker is idle here (all futures resolved, queue
+                # empty), and a fresh peak proves the group RE-FORMED
+                # over the restored pages rather than riding round 1's.
+                b._groups.peak_group = 0
+            texts += [r.text for r in _serve(b, revote)]
+            return texts, mid, b.stats()
+        finally:
+            b.close()
+
+    want, _, _ = run(CFG, False, 0)
+    got, mid, stats = run(CFG.with_(use_pallas=True), True, 64 << 20)
+    assert got == want
+    # The storm really pressured the pool while the group was live —
+    # and could not touch the group's own pages (parity above is the
+    # proof; rc > 1 pages are not evictable by construction).
+    assert mid["prefix_evictions"] > 0
+    assert stats["offload_demoted_pages"] > 0
+    # The re-vote header came back from the host tier (3 full pages of
+    # the 50-id header), and the group re-formed on the restored ids.
+    assert stats["offload_restored_pages"] >= 3
+    assert stats["decode_group_peak"] >= 2
+    assert stats["free_pages"] == stats["total_pages"]
+
+
 # ---------------------------------------------------------------------------
 # Engine N-fanout path
 # ---------------------------------------------------------------------------
