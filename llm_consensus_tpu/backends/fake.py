@@ -17,6 +17,7 @@ from llm_consensus_tpu.backends.base import (
     GenerationRequest,
     GenerationResult,
 )
+from llm_consensus_tpu.utils import tracing as _tracing
 
 
 class FakeBackend(Backend):
@@ -56,6 +57,19 @@ class FakeBackend(Backend):
                 text = self._refiner(req.prompt)
             else:
                 text = self._answerer(req.prompt)
+            # Synthetic engine-phase spans so a request-scoped trace
+            # through the fake has the SAME tree shape as one through
+            # the real serving stack (admission -> prefill -> decode) —
+            # the gateway's tracing acceptance test runs entirely on
+            # this backend.
+            with _tracing.request_span(
+                "prefill_chunk", synthetic=True, prompt_chars=len(req.prompt)
+            ):
+                pass
+            with _tracing.request_span(
+                "decode_step", synthetic=True, tokens=len(text.split())
+            ):
+                pass
             results.append(GenerationResult(text=text, num_tokens=len(text.split())))
         return results
 

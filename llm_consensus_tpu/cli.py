@@ -472,6 +472,42 @@ def build_serve_parser() -> argparse.ArgumentParser:
         default=None,
         help="deadline applied to requests that do not carry one",
     )
+    # Observability (PR 5): request-scoped tracing + profiler bridge.
+    p.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="disable request-scoped tracing (trace ids, /debug/traces "
+        "span trees, and the span-derived histograms' trace side; "
+        "default ON — bench.py --serve-trace-overhead measures the "
+        "cost at < 2%%)",
+    )
+    p.add_argument(
+        "--trace-max-traces",
+        type=int,
+        default=256,
+        help="bounded trace-store ring: retained request traces "
+        "(evict-oldest; drops counted in gateway_trace_dropped_total)",
+    )
+    p.add_argument(
+        "--trace-max-spans",
+        type=int,
+        default=2048,
+        help="span budget per trace (excess spans dropped + counted)",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="enable the X-Profile: 1 request header: capture a JAX "
+        "device profile (TensorBoard format) into this directory for "
+        "the flagged request, aligned with its host trace spans",
+    )
+    p.add_argument(
+        "--ready-stall-s",
+        type=float,
+        default=10.0,
+        help="GET /readyz returns 503 when the backend serving loop's "
+        "heartbeat is older than this (wedged loop)",
+    )
     return p
 
 
@@ -488,6 +524,13 @@ def _run_serve(argv: list[str]) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from llm_consensus_tpu.utils import tracing as _tracing
+
+    if args.no_trace:
+        _tracing.set_enabled(False)
+    _tracing.trace_store().configure(
+        max_traces=args.trace_max_traces, max_spans=args.trace_max_spans
+    )
     panel = load_panel(args.panel) if args.panel else default_panel()
     backend = _build_backend(args)
     gateway = Gateway(
@@ -507,6 +550,8 @@ def _run_serve(argv: list[str]) -> int:
             ),
             max_rounds=args.max_rounds,
             consensus_seed=args.seed,
+            ready_stall_s=args.ready_stall_s,
+            profile_dir=args.profile_dir,
         ),
     )
 

@@ -32,9 +32,11 @@ TPU-native redesign decisions (SURVEY.md §7 step 3):
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import logging
 import random
+import time
 from dataclasses import dataclass, field
 
 from llm_consensus_tpu.backends.base import (
@@ -60,25 +62,42 @@ from llm_consensus_tpu.consensus.prompts import (
     evaluation_prompt,
     refinement_prompt,
 )
-from llm_consensus_tpu.server.metrics import REGISTRY as _REG
+from llm_consensus_tpu.server.metrics import (
+    CONSENSUS_FORCED as _M_FORCED,
+)
+from llm_consensus_tpu.server.metrics import (
+    CONSENSUS_QUESTIONS as _M_QUESTIONS,
+)
+from llm_consensus_tpu.server.metrics import (
+    CONSENSUS_ROUND_SECONDS as _M_ROUND_SECONDS,
+)
+from llm_consensus_tpu.server.metrics import (
+    CONSENSUS_ROUNDS as _M_ROUNDS,
+)
+from llm_consensus_tpu.server.metrics import (
+    CONSENSUS_UNANIMOUS as _M_UNANIMOUS,
+)
+from llm_consensus_tpu.utils import tracing as _tracing
 
 log = logging.getLogger(__name__)
 
-# Process-wide consensus metrics (exported at the gateway's /metrics).
-_M_QUESTIONS = _REG.counter(
-    "consensus_questions_total", "Questions driven through the protocol"
-)
-_M_ROUNDS = _REG.histogram(
-    "consensus_rounds",
-    "Evaluation rounds to termination (unanimity or the round cap)",
-    buckets=(1, 2, 3, 4, 5, 6, 8, 10, 15, 20),
-)
-_M_UNANIMOUS = _REG.counter(
-    "consensus_unanimous_total", "Questions ending in genuine unanimity"
-)
-_M_FORCED = _REG.counter(
-    "consensus_forced_total", "Questions force-terminated at the round cap"
-)
+
+@contextlib.contextmanager
+def _phase_span(phase: str, round_: int):
+    """One protocol-phase timing site, two surfaces in lockstep: a
+    ``consensus_round`` span on the request's trace (when one is
+    active) and a ``consensus_round_seconds{phase=...}`` observation —
+    the phase-resolved latency the TPLA-style disaggregated-serving
+    analysis needs (prefill and decode phases have different rooflines;
+    so do propose/evaluate/refine)."""
+    t0 = time.perf_counter()
+    with _tracing.request_span("consensus_round", phase=phase, round=round_):
+        try:
+            yield
+        finally:
+            _M_ROUND_SECONDS.labels(phase=phase).observe(
+                time.perf_counter() - t0
+            )
 
 
 @dataclass(frozen=True)
@@ -330,9 +349,10 @@ class Coordinator:
         # Random proposer (reference src/main.rs:228-234; quirk #1).
         proposer = self._rng.choice(self.panel)
         log.debug("Received AskQuestion: %s", question)
-        result = await self._call_persona(
-            proposer, answer_prompt(question), required=True
-        )
+        with _phase_span("propose", 0):
+            result = await self._call_persona(
+                proposer, answer_prompt(question), required=True
+            )
         fanout = self.on_answer(
             AnswerQuestion(answer=result.text, author=proposer.name, epoch=epoch)
         )
@@ -343,9 +363,13 @@ class Coordinator:
             # src/main.rs:250-253; on TPU this is one batched decode).
             assert self.answer is not None
             round_ = self.evaluation_count
-            texts = await self._generate_for_panel(
-                [evaluation_prompt(question, self.answer, p) for p in self.panel]
-            )
+            with _phase_span("evaluate", round_):
+                texts = await self._generate_for_panel(
+                    [
+                        evaluation_prompt(question, self.answer, p)
+                        for p in self.panel
+                    ]
+                )
             refinement_request: tuple[str, RefineAnswer] | None = None
             for persona, text in zip(self.panel, texts):
                 verdict, reasoning = parse_evaluation(text)
@@ -364,11 +388,14 @@ class Coordinator:
                 break  # unanimous
             refiner_name, refine_msg = refinement_request
             refiner = self._persona(refiner_name)
-            rres = await self._call_persona(
-                refiner,
-                refinement_prompt(refine_msg.question, refine_msg.answer, refiner),
-                required=True,
-            )
+            with _phase_span("refine", round_):
+                rres = await self._call_persona(
+                    refiner,
+                    refinement_prompt(
+                        refine_msg.question, refine_msg.answer, refiner
+                    ),
+                    required=True,
+                )
             fanout = self.on_refinement(
                 AnswerRefinement(
                     answer=rres.text,

@@ -737,11 +737,27 @@ class InferenceEngine:
         return results
 
     def _span(self, name: str, **meta):
-        if self.tracer is None:
-            import contextlib
+        """Engine instrumentation site: the span lands on the engine's
+        optional flat Tracer AND on the caller's request-scoped trace
+        (propagated here through asyncio.to_thread's context copy) —
+        gateway-driven engine calls show up in ``GET /debug/traces``
+        with no per-call plumbing. Untraced engines keep the free
+        nullcontext fast path."""
+        import contextlib
 
-            return contextlib.nullcontext()
-        return self.tracer.span(name, **meta)
+        from llm_consensus_tpu.utils import tracing as _tracing
+
+        traced = _tracing.current_trace() is not None
+        if self.tracer is None:
+            if not traced:
+                return contextlib.nullcontext()
+            return _tracing.request_span(name, **meta)
+        if not traced:
+            return self.tracer.span(name, **meta)
+        stack = contextlib.ExitStack()
+        stack.enter_context(_tracing.request_span(name, **meta))
+        stack.enter_context(self.tracer.span(name, **meta))
+        return stack
 
     def _generate_prepared(
         self,

@@ -39,6 +39,7 @@ from collections.abc import Awaitable, Callable
 from dataclasses import dataclass, field
 
 from llm_consensus_tpu.server import metrics as _metrics
+from llm_consensus_tpu.utils import tracing as _tracing
 
 __all__ = [
     "AdmissionConfig",
@@ -99,6 +100,11 @@ class _Item:
     deadline: float | None  # monotonic seconds, None = no deadline
     enqueued_at: float
     future: asyncio.Future = field(default_factory=asyncio.Future)
+    # Request trace captured at submit: the dispatcher's _run task has
+    # its own contextvars context (it is NOT a child of the submitter),
+    # so the trace must ride the item and be re-installed around the
+    # thunk (tracing.use_trace) for downstream spans to attach.
+    trace: object | None = None
 
 
 class AdmissionController:
@@ -190,6 +196,7 @@ class AdmissionController:
             priority=prio,
             deadline=(now + deadline_s) if deadline_s is not None else None,
             enqueued_at=now,
+            trace=_tracing.current_trace(),
         )
         q.append(item)
         self._m_admitted.labels(priority=prio).inc()
@@ -282,19 +289,32 @@ class AdmissionController:
                 await self._work.wait()
                 self._work.clear()
                 continue
-            self._m_wait.observe(time.monotonic() - item.enqueued_at)
+            wait = time.monotonic() - item.enqueued_at
+            self._m_wait.observe(wait)
+            if item.trace is not None:
+                # The admission wait, recorded at dispatch (start
+                # reconstructed in the trace's clock).
+                item.trace.add_span(
+                    "queued",
+                    time.perf_counter() - wait,
+                    wait,
+                    priority=item.priority,
+                )
             self._inflight += 1
             self._m_inflight.set(self._inflight)
             asyncio.create_task(self._run(item))
 
     async def _run(self, item: _Item) -> None:
         try:
-            coro = item.thunk()
-            if item.deadline is not None:
-                remaining = item.deadline - time.monotonic()
-                result = await asyncio.wait_for(coro, max(remaining, 0.0))
-            else:
-                result = await coro
+            with _tracing.use_trace(item.trace), _tracing.request_span(
+                "execute", priority=item.priority
+            ):
+                coro = item.thunk()
+                if item.deadline is not None:
+                    remaining = item.deadline - time.monotonic()
+                    result = await asyncio.wait_for(coro, max(remaining, 0.0))
+                else:
+                    result = await coro
         except (asyncio.TimeoutError, TimeoutError):
             self._m_expired.labels(priority=item.priority).inc()
             if not item.future.done():

@@ -38,18 +38,26 @@ class GatewayClient:
 
     # -- plumbing -------------------------------------------------------
 
-    def _open(self, method: str, path: str, payload: dict | None = None):
+    def _open(
+        self,
+        method: str,
+        path: str,
+        payload: dict | None = None,
+        headers: dict | None = None,
+    ):
         """Open one connection and send the request; return
         ``(conn, resp)`` with the response unread, raising
         :class:`GatewayHTTPError` (and closing the connection) on any
         non-200 — the ONE copy of the error prologue, shared by the
         buffered and streaming paths. The caller owns ``conn.close()``
-        on success."""
+        on success. ``headers`` adds/overrides request headers (e.g.
+        ``{"X-Profile": "1"}`` for the gateway's profiler bridge)."""
         conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
         try:
             body = json.dumps(payload).encode() if payload is not None else None
-            headers = {"Content-Type": "application/json"} if body else {}
-            conn.request(method, path, body=body, headers=headers)
+            hdrs = {"Content-Type": "application/json"} if body else {}
+            hdrs.update(headers or {})
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
             if resp.status != 200:
                 data = resp.read()
@@ -64,15 +72,27 @@ class GatewayClient:
             raise
         return conn, resp
 
-    def _request(self, method: str, path: str, payload: dict | None = None):
-        conn, resp = self._open(method, path, payload)
+    def _request(
+        self,
+        method: str,
+        path: str,
+        payload: dict | None = None,
+        headers: dict | None = None,
+    ):
+        conn, resp = self._open(method, path, payload, headers)
         try:
             return resp, resp.read()
         finally:
             conn.close()
 
-    def _json(self, method: str, path: str, payload: dict | None = None):
-        _, data = self._request(method, path, payload)
+    def _json(
+        self,
+        method: str,
+        path: str,
+        payload: dict | None = None,
+        headers: dict | None = None,
+    ):
+        _, data = self._request(method, path, payload, headers)
         return json.loads(data)
 
     # -- API ------------------------------------------------------------
@@ -80,25 +100,39 @@ class GatewayClient:
     def healthz(self) -> dict:
         return self._json("GET", "/healthz")
 
+    def readyz(self) -> dict:
+        """``GET /readyz``; raises GatewayHTTPError(503) when unready."""
+        return self._json("GET", "/readyz")
+
+    def traces(self, trace_id: str | None = None) -> dict:
+        """``GET /debug/traces`` (summaries) or one trace's span tree."""
+        path = "/debug/traces" + (f"?id={trace_id}" if trace_id else "")
+        return self._json("GET", path)
+
     def metrics(self) -> str:
         _, data = self._request("GET", "/metrics")
         return data.decode()
 
-    def generate(self, prompt: str, **params) -> dict:
-        """``POST /v1/generate`` -> ``{"text", "num_tokens", "logprob"}``.
+    def generate(self, prompt: str, headers: dict | None = None, **params) -> dict:
+        """``POST /v1/generate`` -> ``{"text", "num_tokens", "logprob",
+        "trace_id"}``.
 
         Keyword params pass through to the request body
         (max_new_tokens, temperature, top_k, top_p, seed, stop,
-        priority, deadline_s, model).
+        priority, deadline_s, model); ``headers`` adds request headers
+        (e.g. ``{"X-Profile": "1"}``).
         """
         return self._json(
-            "POST", "/v1/generate", {"prompt": prompt, **params}
+            "POST", "/v1/generate", {"prompt": prompt, **params}, headers
         )
 
-    def consensus(self, question: str, **params) -> dict:
-        """``POST /v1/consensus`` -> answer/rounds/endorsed/author/feedback."""
+    def consensus(
+        self, question: str, headers: dict | None = None, **params
+    ) -> dict:
+        """``POST /v1/consensus`` -> answer/rounds/endorsed/author/
+        feedback/trace_id."""
         return self._json(
-            "POST", "/v1/consensus", {"question": question, **params}
+            "POST", "/v1/consensus", {"question": question, **params}, headers
         )
 
     def stream_generate(self, prompt: str, **params) -> Iterator[dict]:

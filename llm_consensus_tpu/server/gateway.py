@@ -21,7 +21,15 @@ Routes:
   ``{"question": ..., "max_rounds"?, "seed"?, "priority"?,
   "deadline_s"?}`` and returns answer/rounds/endorsed/author/feedback.
 - ``GET /metrics`` — Prometheus text exposition of the registry.
-- ``GET /healthz`` — liveness + drain state.
+- ``GET /healthz`` — LIVENESS: process up, drain state, backend
+  heartbeat ages (always 200 while the process can answer).
+- ``GET /readyz`` — READINESS: 503 while draining or while the
+  backend's serving-loop heartbeat is staler than
+  ``GatewayConfig.ready_stall_s`` (wedged loop => pull this replica
+  from rotation without killing it).
+- ``GET /debug/traces`` — request-trace summaries (newest first);
+  ``?id=<trace_id>`` returns one trace's full span tree. Every
+  ``/v1/*`` response carries its ``trace_id`` (body + ``X-Trace-Id``).
 
 Status mapping: 429 + ``Retry-After`` on shed, 503 + ``Retry-After``
 while draining, 504 on deadline expiry, 502 on backend failure, 400 on
@@ -62,6 +70,7 @@ from llm_consensus_tpu.server.admission import (
     DrainingError,
     QueueFullError,
 )
+from llm_consensus_tpu.utils import tracing as _tracing
 
 log = logging.getLogger(__name__)
 
@@ -110,6 +119,15 @@ class GatewayConfig:
         # Coordinator defaults for /v1/consensus.
         max_rounds: int = 5,
         consensus_seed: int | None = None,
+        # Readiness (GET /readyz): 503 when the backend's serving loop
+        # heartbeat is older than this (wedged device call, deadlock).
+        # Size it above the longest legitimate device program.
+        ready_stall_s: float = 10.0,
+        # Opt-in JAX device profiling: a request carrying
+        # ``X-Profile: 1`` wraps its backend work in
+        # ``jax.profiler.trace(profile_dir)`` (one at a time; TensorBoard
+        # format, aligned with the request's host spans). None = off.
+        profile_dir: str | None = None,
     ):
         self.host = host
         self.port = port
@@ -119,6 +137,8 @@ class GatewayConfig:
         self.sampling = sampling or SamplingParams()
         self.max_rounds = max_rounds
         self.consensus_seed = consensus_seed
+        self.ready_stall_s = ready_stall_s
+        self.profile_dir = profile_dir
 
 
 class Gateway:
@@ -151,6 +171,9 @@ class Gateway:
         self._conn_tasks: set[asyncio.Task] = set()
         self.port: int | None = None  # actual bound port (ephemeral-safe)
         self._started = time.monotonic()
+        # One device profile at a time: jax.profiler.start_trace is
+        # process-global and errors on nesting.
+        self._profile_lock = threading.Lock()
         reg = self.registry
         self._m_requests = reg.counter(
             "gateway_requests_total", "HTTP requests by route and status"
@@ -297,20 +320,68 @@ class Gateway:
             raise _HTTPError(413, f"body of {n} bytes exceeds limit")
         if n:
             body = await reader.readexactly(n)
-        return method, path.partition("?")[0], headers, body
+        return method, path, headers, body
+
+    def _health_doc(self) -> dict:
+        """Liveness payload: process-level state + the backend's serving
+        loop heartbeat (when it exposes one)."""
+        doc = {
+            "status": "draining" if self.admission.draining else "ok",
+            "pending": self.admission.pending(),
+            "uptime_s": round(time.monotonic() - self._started, 3),
+        }
+        health = getattr(self.backend, "health", None)
+        if callable(health):
+            try:
+                doc["backend"] = health()
+            except Exception as e:  # noqa: BLE001 - health must not 500
+                doc["backend"] = {"error": repr(e)}
+        return doc
+
+    def _readiness(self) -> tuple[bool, dict]:
+        """Readiness: NOT ready while draining or while the backend's
+        serving loop heartbeat is stale (wedged loop => stop routing
+        traffic here; liveness stays 200 so the process isn't killed)."""
+        doc = self._health_doc()
+        if self.admission.draining:
+            return False, {**doc, "reason": "draining"}
+        hb = doc.get("backend") or {}
+        if "error" in hb:
+            # Fail CLOSED: a health probe that RAISES means the serving
+            # loop's state is unknown — stop routing traffic here.
+            return False, {**doc, "reason": f"health probe failed: {hb['error']}"}
+        age = hb.get("last_tick_age_s")
+        if hb.get("alive") is False:
+            return False, {**doc, "reason": "serving loop dead"}
+        if age is not None and age > self.config.ready_stall_s:
+            return False, {
+                **doc,
+                "reason": (
+                    f"serving loop stalled {age:.1f}s "
+                    f"(> {self.config.ready_stall_s}s)"
+                ),
+            }
+        return True, doc
 
     async def _route(self, method, path, headers, body, writer) -> None:
+        path, _, rawq = path.partition("?")
         if path == "/healthz" and method == "GET":
+            await self._respond_json(writer, 200, self._health_doc())
+            self._count(path, 200)
+            return
+        if path == "/readyz" and method == "GET":
+            ready, doc = self._readiness()
+            status = 200 if ready else 503
             await self._respond_json(
                 writer,
-                200,
-                {
-                    "status": "draining" if self.admission.draining else "ok",
-                    "pending": self.admission.pending(),
-                    "uptime_s": round(time.monotonic() - self._started, 3),
-                },
+                status,
+                {**doc, "ready": ready},
+                None if ready else {"Retry-After": "5"},
             )
-            self._count(path, 200)
+            self._count(path, status)
+            return
+        if path == "/debug/traces" and method == "GET":
+            await self._handle_traces(rawq, writer)
             return
         if path == "/metrics" and method == "GET":
             text = self.registry.render().encode()
@@ -335,16 +406,86 @@ class Gateway:
                 self._count(path, 400)
                 return
             if path == "/v1/generate":
-                await self._handle_generate(payload, writer)
+                await self._handle_generate(payload, headers, writer)
             else:
-                await self._handle_consensus(payload, writer)
+                await self._handle_consensus(payload, headers, writer)
             return
         await self._respond_json(writer, 404, {"error": f"no route {path}"})
         # Arbitrary client paths must not become metric labels (a port
         # scan would grow the family without bound): one shared label.
         self._count("<unmatched>", 404)
 
+    async def _handle_traces(self, rawq: str, writer) -> None:
+        """``GET /debug/traces``: newest-first summaries; ``?id=<trace>``
+        returns that trace's full span tree; ``?limit=N`` bounds the
+        listing."""
+        from urllib.parse import parse_qs
+
+        q = parse_qs(rawq)
+        store = _tracing.trace_store()
+        tid = (q.get("id") or [None])[0]
+        if tid:
+            trace = store.get(tid)
+            if trace is None:
+                await self._respond_json(
+                    writer, 404, {"error": f"no trace {tid!r}"}
+                )
+                self._count("/debug/traces", 404)
+                return
+            await self._respond_json(writer, 200, trace.to_dict())
+            self._count("/debug/traces", 200)
+            return
+        try:
+            limit = int((q.get("limit") or ["50"])[0])
+        except ValueError:
+            limit = 50
+        await self._respond_json(
+            writer,
+            200,
+            {
+                "enabled": _tracing.enabled(),
+                "max_traces": store.max_traces,
+                "max_spans_per_trace": store.max_spans,
+                "evicted_traces": store.evicted,
+                "traces": [t.summary() for t in store.traces(limit)],
+            },
+        )
+        self._count("/debug/traces", 200)
+
     # -- routes ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def _maybe_profile(self, headers: dict):
+        """``X-Profile: 1`` (with ``GatewayConfig.profile_dir`` set)
+        captures a JAX device profile around this request's backend
+        work — a TensorBoard trace in ``profile_dir`` aligned with the
+        request's host spans (a ``jax_profile`` span marks the window
+        on the trace). One capture at a time: concurrent flagged
+        requests run unprofiled rather than queueing on the profiler's
+        process-global state. SSE streaming requests are not profiled
+        (their backend work outlives the handler's await points)."""
+        if not (
+            self.config.profile_dir
+            and headers.get("x-profile", "").strip() == "1"
+        ):
+            yield False
+            return
+        if not self._profile_lock.acquire(blocking=False):
+            log.warning("X-Profile ignored: a device profile is in flight")
+            yield False
+            return
+        try:
+            with _tracing.request_span(
+                "jax_profile", logdir=self.config.profile_dir
+            ), _tracing.trace_jax_profile(self.config.profile_dir):
+                yield True
+        finally:
+            self._profile_lock.release()
+
+    @staticmethod
+    def _trace_id() -> str | None:
+        trace = _tracing.current_trace()
+        return trace.trace_id if trace is not None else None
 
     def _sampling_from(self, payload: dict) -> SamplingParams:
         d = self.config.sampling
@@ -375,7 +516,7 @@ class Gateway:
             kw["deadline_s"] = d
         return kw
 
-    async def _handle_generate(self, payload: dict, writer) -> None:
+    async def _handle_generate(self, payload: dict, headers, writer) -> None:
         prompt = payload.get("prompt")
         if not isinstance(prompt, str) or not prompt:
             await self._respond_json(
@@ -398,21 +539,56 @@ class Gateway:
             )
             self._count("/v1/generate", 400)
             return
+        # The trace is minted AFTER validation (a 400 never mints one)
+        # and discarded again if admission sheds the request — a 429
+        # storm must not churn the bounded ring and evict the slow
+        # traces being debugged. Everything downstream — admission
+        # queue, coordinator rounds, batcher chunks/steps — attaches
+        # spans through the contextvars protocol or explicit trace
+        # handles (None when tracing is disabled: every site no-ops).
+        trace = _tracing.trace_store().start(
+            "/v1/generate", route="/v1/generate"
+        )
         t0 = time.monotonic()
         if payload.get("stream"):
-            await self._handle_generate_stream(req, adm_kw, writer, t0)
+            try:
+                with _tracing.use_trace(trace):
+                    await self._handle_generate_stream(
+                        req, adm_kw, writer, t0
+                    )
+            finally:
+                if trace is not None:
+                    trace.finish()
             return
+
+        async def thunk():
+            # Profiling wraps ONLY the backend call, inside the
+            # dispatched thunk: the capture window (and the one-at-a-
+            # time profiler slot) must not include the admission-queue
+            # wait, where it would mostly record OTHER requests' work.
+            with self._maybe_profile(headers):
+                return await self.backend.generate(req)
+
         try:
-            result: GenerationResult = await self.admission.submit(
-                lambda: self.backend.generate(req), **adm_kw
-            )
+            with _tracing.use_trace(trace):
+                result: GenerationResult = await self.admission.submit(
+                    thunk, **adm_kw
+                )
         except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
-            status, doc, headers = self._error_response(e)
-            await self._respond_json(writer, status, doc, headers)
+            status, doc, hdrs = self._error_response(e)
+            if trace is not None and isinstance(
+                e, (QueueFullError, DrainingError)
+            ):
+                _tracing.trace_store().discard(trace.trace_id)
+            await self._respond_json(writer, status, doc, hdrs)
             self._count("/v1/generate", status)
             return
+        finally:
+            if trace is not None:
+                trace.finish()
         dt = time.monotonic() - t0
         self._observe_generation(dt, dt, result.num_tokens)
+        tid = trace.trace_id if trace is not None else None
         await self._respond_json(
             writer,
             200,
@@ -420,7 +596,9 @@ class Gateway:
                 "text": result.text,
                 "num_tokens": result.num_tokens,
                 "logprob": result.logprob,
+                "trace_id": tid,
             },
+            {"X-Trace-Id": tid} if tid else None,
         )
         self._count("/v1/generate", 200)
 
@@ -489,6 +667,12 @@ class Gateway:
             return
         except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
             status, doc, headers = self._error_response(e)
+            if isinstance(e, (QueueFullError, DrainingError)):
+                # Same discard the buffered paths apply: a shed stream
+                # did no work, and a 429 storm must not churn the ring.
+                trace = _tracing.current_trace()
+                if trace is not None:
+                    _tracing.trace_store().discard(trace.trace_id)
             if headers_sent:
                 # Mid-stream failure: the status line is gone; surface a
                 # terminal error event instead.
@@ -507,7 +691,12 @@ class Gateway:
             self._m_ttft.observe(dt)
         self._observe_generation(None, dt, result.num_tokens)
         await self._sse_event(
-            writer, {"done": True, "num_tokens": result.num_tokens}
+            writer,
+            {
+                "done": True,
+                "num_tokens": result.num_tokens,
+                "trace_id": self._trace_id(),
+            },
         )
         await self._sse_done(writer)
         self._count("/v1/generate", 200)
@@ -528,7 +717,7 @@ class Gateway:
             push(piece)
         return result
 
-    async def _handle_consensus(self, payload: dict, writer) -> None:
+    async def _handle_consensus(self, payload: dict, headers, writer) -> None:
         from llm_consensus_tpu.consensus.coordinator import (
             Coordinator,
             CoordinatorConfig,
@@ -556,24 +745,38 @@ class Gateway:
             )
             self._count("/v1/consensus", 400)
             return
+        trace = _tracing.trace_store().start(
+            "/v1/consensus", route="/v1/consensus"
+        )
         t0 = time.monotonic()
 
-        def thunk():
+        async def thunk():
             # A fresh coordinator per request: the protocol state machine
             # is per-question; panel/backend/config are the shared parts.
+            # Profiling wraps only this execution, never the queue wait.
             coord = Coordinator(list(self.panel), self.backend, cfg)
-            return coord.run(question)
+            with self._maybe_profile(headers):
+                return await coord.run(question)
 
         try:
-            result = await self.admission.submit(thunk, **adm_kw)
+            with _tracing.use_trace(trace):
+                result = await self.admission.submit(thunk, **adm_kw)
         except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
-            status, doc, headers = self._error_response(e)
-            await self._respond_json(writer, status, doc, headers)
+            status, doc, hdrs = self._error_response(e)
+            if trace is not None and isinstance(
+                e, (QueueFullError, DrainingError)
+            ):
+                _tracing.trace_store().discard(trace.trace_id)
+            await self._respond_json(writer, status, doc, hdrs)
             self._count("/v1/consensus", status)
             return
+        finally:
+            if trace is not None:
+                trace.finish()
         dt = time.monotonic() - t0
         self._m_ttft.observe(dt)
         self._m_latency.observe(dt)
+        tid = trace.trace_id if trace is not None else None
         await self._respond_json(
             writer,
             200,
@@ -583,7 +786,9 @@ class Gateway:
                 "endorsed": result.endorsed,
                 "author": result.author,
                 "feedback": {k: v.value for k, v in result.feedback.items()},
+                "trace_id": tid,
             },
+            {"X-Trace-Id": tid} if tid else None,
         )
         self._count("/v1/consensus", 200)
 

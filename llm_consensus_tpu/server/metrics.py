@@ -32,6 +32,27 @@ __all__ = [
     "REGISTRY",
     "LATENCY_BUCKETS",
     "THROUGHPUT_BUCKETS",
+    "OCCUPANCY_BUCKETS",
+    "INSTANCE_FAMILIES",
+    "SERVING_SUBMITTED",
+    "SERVING_COMPLETED",
+    "SERVING_TOKENS",
+    "SERVING_STEPS",
+    "SERVING_WAITING",
+    "SERVING_ACTIVE",
+    "SERVING_OCCUPANCY",
+    "SCHED_SUBMITTED",
+    "SCHED_DEPTH",
+    "SCHED_OCCUPANCY",
+    "CONSENSUS_QUESTIONS",
+    "CONSENSUS_ROUNDS",
+    "CONSENSUS_UNANIMOUS",
+    "CONSENSUS_FORCED",
+    "CONSENSUS_ROUND_SECONDS",
+    "GATEWAY_TTFT",
+    "DECODE_STEP_SECONDS",
+    "SCHED_OVERHEAD_SECONDS",
+    "TRACE_DROPPED",
     "PREFIX_PAGES_SHARED",
     "PREFIX_PAGES_COPIED",
     "PREFIX_LOOKUPS",
@@ -57,6 +78,8 @@ THROUGHPUT_BUCKETS = (
     1.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0,
     10_000.0, 50_000.0, 100_000.0, 500_000.0,
 )
+# Batch-occupancy: requests packed per executed program/step.
+OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 def _fmt(v: float) -> str:
@@ -66,12 +89,23 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, double quote,
+    AND line feed (``\\n``) — an unescaped newline in a label value ends
+    the sample line mid-token and corrupts the whole exposition."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _label_str(labels: tuple[tuple[str, str], ...]) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        '{}="{}"'.format(k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
-        for k, v in labels
+        '{}="{}"'.format(k, _escape_label_value(v)) for k, v in labels
     )
     return "{" + inner + "}"
 
@@ -403,3 +437,137 @@ KV_HOST_TIER_BYTES = REGISTRY.gauge(
     "gateway_kv_host_tier_bytes",
     "Bytes resident in the host-RAM KV offload tier",
 )
+
+
+# ---------------------------------------------------------------------------
+# Serving / scheduler / consensus process-wide families (PR 5: moved
+# here from their instrumentation modules so the canonical surface is
+# enumerable in ONE file — scripts/check_metrics.py enforces that every
+# family those modules feed is declared here and documented in the
+# README observability table).
+# ---------------------------------------------------------------------------
+
+SERVING_SUBMITTED = REGISTRY.counter(
+    "serving_requests_total", "Requests submitted to the continuous batcher"
+)
+SERVING_COMPLETED = REGISTRY.counter(
+    "serving_completed_total", "Requests retired by the continuous batcher"
+)
+SERVING_TOKENS = REGISTRY.counter(
+    "serving_generated_tokens_total", "Tokens generated (incl. EOS)"
+)
+SERVING_STEPS = REGISTRY.counter(
+    "serving_decode_steps_total", "Device decode steps executed"
+)
+SERVING_WAITING = REGISTRY.gauge(
+    "serving_waiting", "Requests waiting for a continuous-batcher slot"
+)
+SERVING_ACTIVE = REGISTRY.gauge(
+    "serving_active_slots", "Continuous-batcher slots currently decoding"
+)
+SERVING_OCCUPANCY = REGISTRY.histogram(
+    "serving_slot_occupancy",
+    "Active slots per decode step (batch occupancy)",
+    buckets=OCCUPANCY_BUCKETS,
+)
+SCHED_SUBMITTED = REGISTRY.counter(
+    "scheduler_requests_total", "Requests submitted to the batch scheduler"
+)
+SCHED_DEPTH = REGISTRY.gauge(
+    "scheduler_queue_depth", "Requests pending in the batch scheduler"
+)
+SCHED_OCCUPANCY = REGISTRY.histogram(
+    "scheduler_batch_occupancy",
+    "Requests packed per executed scheduler batch",
+    buckets=OCCUPANCY_BUCKETS,
+)
+CONSENSUS_QUESTIONS = REGISTRY.counter(
+    "consensus_questions_total", "Questions driven through the protocol"
+)
+CONSENSUS_ROUNDS = REGISTRY.histogram(
+    "consensus_rounds",
+    "Evaluation rounds to termination (unanimity or the round cap)",
+    buckets=(1, 2, 3, 4, 5, 6, 8, 10, 15, 20),
+)
+CONSENSUS_UNANIMOUS = REGISTRY.counter(
+    "consensus_unanimous_total", "Questions ending in genuine unanimity"
+)
+CONSENSUS_FORCED = REGISTRY.counter(
+    "consensus_forced_total", "Questions force-terminated at the round cap"
+)
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped tracing (PR 5): histograms derived from the same
+# instrumentation points that record trace spans, so ``/metrics``,
+# ``stats()``, and ``GET /debug/traces`` stay in lockstep.
+# ---------------------------------------------------------------------------
+
+#: Canonical declaration of the gateway's TTFT histogram (instances
+#: with isolated registries re-create it per registry; see
+#: INSTANCE_FAMILIES below).
+GATEWAY_TTFT = REGISTRY.histogram(
+    "gateway_ttft_seconds",
+    "Time from request arrival to first token byte",
+)
+#: One observation per decode-step device program: dispatch through the
+#: host fetch of the sampled tokens (the true device step latency the
+#: per-trace "decode_step" spans record).
+DECODE_STEP_SECONDS = REGISTRY.histogram(
+    "gateway_decode_step_seconds",
+    "Continuous-batcher decode-step device latency (dispatch to fetch)",
+)
+#: Host time BETWEEN consecutive device decode steps — retirement,
+#: admission, prefill-chunk scheduling, group rebuilds. The scheduler
+#: overhead the decode roofline never shows; idle waits do not count.
+SCHED_OVERHEAD_SECONDS = REGISTRY.histogram(
+    "gateway_sched_overhead_seconds",
+    "Host time between consecutive decode steps (scheduling overhead)",
+)
+#: Consensus protocol phase latency, labeled
+#: ``phase="propose"|"evaluate"|"refine"`` — one observation per phase
+#: execution (an evaluation round and its refinement observe
+#: separately). Mirrors the per-trace "consensus_round" spans.
+CONSENSUS_ROUND_SECONDS = REGISTRY.histogram(
+    "consensus_round_seconds",
+    "Consensus phase latency by phase (propose/evaluate/refine)",
+)
+#: Ring-buffer pressure in the tracing layer, labeled
+#: ``kind="span"`` (a span evicted/refused by a full Tracer ring or a
+#: full per-trace span budget) or ``kind="trace"`` (a whole trace
+#: evicted from the bounded TraceStore). Fed via the tracing drop hook
+#: wired below — the lockstep contract between the two surfaces.
+TRACE_DROPPED = REGISTRY.counter(
+    "gateway_trace_dropped_total",
+    "Spans/traces dropped by the bounded tracing ring buffers",
+)
+
+
+# ---------------------------------------------------------------------------
+# Canonical manifest of families created on PER-INSTANCE registries
+# (gateway/admission accept an isolated MetricsRegistry for test
+# isolation, so their families cannot be module-level objects here).
+# scripts/check_metrics.py treats these names as declared; add a row
+# here AND to the README observability table when instrumenting a new
+# one.
+# ---------------------------------------------------------------------------
+
+INSTANCE_FAMILIES: dict[str, str] = {
+    "gateway_requests_total": "counter",
+    "gateway_request_seconds": "histogram",
+    "gateway_tokens_per_second": "histogram",
+    "gateway_queue_depth": "gauge",
+    "gateway_inflight": "gauge",
+    "gateway_admitted_total": "counter",
+    "gateway_shed_total": "counter",
+    "gateway_deadline_expired_total": "counter",
+    "gateway_completed_total": "counter",
+    "gateway_queue_wait_seconds": "histogram",
+}
+
+
+# Mirror tracing-layer drops into the registry (lockstep: the hook runs
+# at the drop site, inside the tracing module's accounting).
+from llm_consensus_tpu.utils import tracing as _tracing  # noqa: E402
+
+_tracing.set_drop_hook(lambda kind, n: TRACE_DROPPED.labels(kind=kind).inc(n))

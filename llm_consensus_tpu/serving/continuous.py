@@ -141,34 +141,36 @@ from llm_consensus_tpu.server.metrics import (
 from llm_consensus_tpu.server.metrics import (
     KV_RESTORE_SECONDS as _M_RESTORE_SECONDS,
 )
-from llm_consensus_tpu.server.metrics import REGISTRY as _REG
+from llm_consensus_tpu.server.metrics import (
+    DECODE_STEP_SECONDS as _M_STEP_SECONDS,
+)
+from llm_consensus_tpu.server.metrics import (
+    SCHED_OVERHEAD_SECONDS as _M_SCHED_OVERHEAD,
+)
+from llm_consensus_tpu.server.metrics import (
+    SERVING_ACTIVE as _M_ACTIVE,
+)
+from llm_consensus_tpu.server.metrics import (
+    SERVING_COMPLETED as _M_COMPLETED,
+)
+from llm_consensus_tpu.server.metrics import (
+    SERVING_OCCUPANCY as _M_OCCUPANCY,
+)
+from llm_consensus_tpu.server.metrics import (
+    SERVING_STEPS as _M_STEPS,
+)
+from llm_consensus_tpu.server.metrics import (
+    SERVING_SUBMITTED as _M_SUBMITTED,
+)
+from llm_consensus_tpu.server.metrics import (
+    SERVING_TOKENS as _M_TOKENS,
+)
+from llm_consensus_tpu.server.metrics import (
+    SERVING_WAITING as _M_WAITING,
+)
+from llm_consensus_tpu.utils import tracing as _tracing
 
 log = logging.getLogger(__name__)
-
-# Process-wide serving metrics (exported at the gateway's /metrics).
-_M_SUBMITTED = _REG.counter(
-    "serving_requests_total", "Requests submitted to the continuous batcher"
-)
-_M_COMPLETED = _REG.counter(
-    "serving_completed_total", "Requests retired by the continuous batcher"
-)
-_M_TOKENS = _REG.counter(
-    "serving_generated_tokens_total", "Tokens generated (incl. EOS)"
-)
-_M_STEPS = _REG.counter(
-    "serving_decode_steps_total", "Device decode steps executed"
-)
-_M_WAITING = _REG.gauge(
-    "serving_waiting", "Requests waiting for a continuous-batcher slot"
-)
-_M_ACTIVE = _REG.gauge(
-    "serving_active_slots", "Continuous-batcher slots currently decoding"
-)
-_M_OCCUPANCY = _REG.histogram(
-    "serving_slot_occupancy",
-    "Active slots per decode step (batch occupancy)",
-    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
-)
 
 
 @dataclass
@@ -258,6 +260,10 @@ class _Request:
     # re-encoding them per sampled token would put tokenizer calls on
     # the thread pacing device steps).
     stop_window: int = 0
+    # Request-scoped trace captured from the submitter's context: the
+    # worker thread attaches prefill-chunk/decode-step/restore spans to
+    # it explicitly (contextvars do not cross the thread boundary).
+    trace: object | None = None
 
 
 @dataclass
@@ -416,6 +422,22 @@ class ContinuousBatcher:
         self._generated_tokens = 0
         self._decode_steps = 0
         self._prefill_chunks = 0
+        # Span-derived step telemetry (PR 5): the SAME observations feed
+        # the Prometheus histograms and these accumulators, so stats()
+        # and /metrics cannot drift. _last_step_end is the perf_counter
+        # stamp of the previous decode step's host fetch; None = the
+        # loop idled since (idle waits are not scheduling overhead).
+        self._decode_step_sum = 0.0
+        self._decode_step_count = 0
+        self._sched_overhead_sum = 0.0
+        self._sched_overhead_count = 0
+        self._last_step_end: float | None = None
+        # Liveness heartbeat: stamped at the top of every host-loop
+        # iteration (the idle loop ticks at >= 10 Hz), and after each
+        # decode step. The gateway's readiness probe compares the tick
+        # age against its stall threshold.
+        self._hb_tick = time.monotonic()
+        self._hb_step: float | None = None
         self._vis_filter = VisibleIdFilter(
             self.tokenizer, skip_ids=(self.tokenizer.eos_id,)
         )
@@ -594,6 +616,7 @@ class ContinuousBatcher:
             top_p=dflt.top_p if top_p is None else top_p,
             stop=stop,
             stop_window=window,
+            trace=_tracing.current_trace(),
         )
         with self._lock:
             self._waiting.append(req)
@@ -601,6 +624,21 @@ class ContinuousBatcher:
         _M_SUBMITTED.inc()
         self._work.set()
         return req.future
+
+    def heartbeat(self) -> dict:
+        """Host-loop liveness: seconds since the last loop tick and the
+        last decode step. The loop ticks at >= 10 Hz even when idle, so
+        a large ``last_tick_age_s`` means the worker is wedged (stuck
+        device call, deadlock) — the gateway's ``/readyz`` probe flips
+        to 503 past its stall threshold."""
+        now = time.monotonic()
+        return {
+            "alive": self._thread.is_alive() and not self._stop.is_set(),
+            "last_tick_age_s": now - self._hb_tick,
+            "last_step_age_s": (
+                now - self._hb_step if self._hb_step is not None else None
+            ),
+        }
 
     def stats(self) -> dict:
         """Live serving counters — a consistent snapshot (the worker
@@ -661,6 +699,14 @@ class ContinuousBatcher:
                 "offload_host_pages": (
                     len(self._offload) if self._offload else 0
                 ),
+                # Span-derived step telemetry (PR 5): the same
+                # observations that feed gateway_decode_step_seconds /
+                # gateway_sched_overhead_seconds — one instrumentation
+                # site, two surfaces (lockstep tested).
+                "decode_step_seconds_sum": self._decode_step_sum,
+                "decode_step_seconds_count": self._decode_step_count,
+                "sched_overhead_seconds_sum": self._sched_overhead_sum,
+                "sched_overhead_seconds_count": self._sched_overhead_count,
             }
 
     def close(self) -> None:
@@ -926,7 +972,7 @@ class ContinuousBatcher:
                     ]
                     assert len(restore_nodes) == len(restore_plan)
                     for node, planes in zip(restore_nodes, restore_plan):
-                        self._restores.append((node, planes))
+                        self._restores.append((node, planes, req.trace))
                 padded = np.full((end,), self.tokenizer.pad_id, np.int32)
                 padded[:L] = ids
                 deps = restore_nodes + [
@@ -1006,7 +1052,7 @@ class ContinuousBatcher:
         """
         if not self._restores:
             return False
-        node, planes = self._restores.popleft()
+        node, planes, trace = self._restores.popleft()
         t0 = time.perf_counter()
         self.cache = self._jit_install_page(
             self.cache,
@@ -1018,7 +1064,10 @@ class ContinuousBatcher:
         # contract as a prefill chunk's block) — and the histogram's
         # point is the true host->device promotion latency.
         jax.block_until_ready(self.cache.length)
-        _M_RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        _M_RESTORE_SECONDS.observe(dur)
+        if trace is not None:
+            trace.add_span("kv_restore", t0, dur, page=int(node.page))
         node.ready = True
         _M_OFF_RESTORED.inc()
         with self._lock:
@@ -1072,7 +1121,13 @@ class ContinuousBatcher:
         # histogram records it and (b) successors read the pages this
         # chunk wrote.
         jax.block_until_ready(self.cache.length)
-        _M_PREFILL_STALL.observe(time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        _M_PREFILL_STALL.observe(dur)
+        trace = slot.request.trace
+        if trace is not None:
+            trace.add_span(
+                "prefill_chunk", t0, dur, pos=slot.next_pos, chunk=slot.chunk
+            )
         written_real = min(written_end, slot.prompt_len)
         for node, end_pos in slot.reg_nodes:
             if not node.ready and end_pos <= written_real:
@@ -1288,6 +1343,16 @@ class ContinuousBatcher:
             return arr
 
         groups = self._groups.arrays() if self._group_decode else None
+        # Host time since the previous step's fetch = scheduling
+        # overhead (retirement, admission, prefill chunks, group
+        # rebuilds); idle waits reset _last_step_end and never count.
+        t0 = time.perf_counter()
+        if self._last_step_end is not None:
+            overhead = t0 - self._last_step_end
+            _M_SCHED_OVERHEAD.observe(overhead)
+            with self._lock:
+                self._sched_overhead_sum += overhead
+                self._sched_overhead_count += 1
         next_tok, _, self.cache = self._jit_decode(
             self.params,
             self.cache,
@@ -1300,9 +1365,17 @@ class ContinuousBatcher:
             filters_active,
             groups,
         )
+        next_np = np.asarray(next_tok)  # [slots, k] — THE host sync
+        step_end = time.perf_counter()
+        dur = step_end - t0
+        self._last_step_end = step_end
+        self._hb_step = time.monotonic()
+        _M_STEP_SECONDS.observe(dur)
         k = max(1, self.config.steps_per_sync)
         with self._lock:
             self._decode_steps += k
+            self._decode_step_sum += dur
+            self._decode_step_count += 1
             active = self._decoding()
             if groups is not None:
                 # Shared pages read once per group instead of once per
@@ -1313,13 +1386,25 @@ class ContinuousBatcher:
                     * k
                 )
                 self._kv_bytes_saved += saved
+        # One "decode_step" span per DISTINCT trace among the step's
+        # decoding slots: a batched step belongs to every request it
+        # advanced (the per-trace span budget bounds long decodes).
+        step_traces: dict[int, object] = {}
+        for slot in self._slots:
+            if (
+                slot is not None
+                and slot.phase == "decode"
+                and slot.request.trace is not None
+            ):
+                step_traces[id(slot.request.trace)] = slot.request.trace
+        for tr in step_traces.values():
+            tr.add_span("decode_step", t0, dur, active=active, k=k)
         _M_STEPS.inc(k)
         _M_GROUP_SIZE.set(self._groups.largest_group if groups is not None else 0)
         if groups is not None:
             _M_KV_SAVED.inc(saved)
         if active:
             _M_OCCUPANCY.observe(active)
-        next_np = np.asarray(next_tok)  # [slots, k] — THE host sync
         for i, slot in enumerate(self._slots):
             if slot is None or slot.phase != "decode":
                 continue
@@ -1346,6 +1431,7 @@ class ContinuousBatcher:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            self._hb_tick = time.monotonic()
             self._admit()
             progress = False
             # At most ONE prefill work unit between decode steps —
@@ -1359,7 +1445,12 @@ class ContinuousBatcher:
             if self._decoding():
                 self._step()
                 progress = True
+            else:
+                # No device step ran: the gap to the next one is not
+                # scheduling overhead (the batch went empty).
+                self._last_step_end = None
             if not progress:
+                self._last_step_end = None
                 self._work.wait(timeout=0.1)
                 self._work.clear()
 
@@ -1414,6 +1505,10 @@ class ContinuousBackend(_backend_base.Backend):
             GenerationResult(text=o.text, num_tokens=o.num_tokens)
             for o in outs
         ]
+
+    def health(self) -> dict:
+        """Gateway readiness probe surface: the batcher heartbeat."""
+        return self.batcher.heartbeat()
 
     async def close(self) -> None:
         self.batcher.close()

@@ -38,22 +38,18 @@ from llm_consensus_tpu.backends.base import (
 )
 from llm_consensus_tpu.engine.engine import InferenceEngine
 from llm_consensus_tpu.engine.sampler import SamplerConfig
-from llm_consensus_tpu.server.metrics import REGISTRY as _REG
+from llm_consensus_tpu.server.metrics import (
+    SCHED_DEPTH as _M_DEPTH,
+)
+from llm_consensus_tpu.server.metrics import (
+    SCHED_OCCUPANCY as _M_OCCUPANCY,
+)
+from llm_consensus_tpu.server.metrics import (
+    SCHED_SUBMITTED as _M_SUBMITTED,
+)
+from llm_consensus_tpu.utils import tracing as _tracing
 
 log = logging.getLogger(__name__)
-
-# Process-wide serving metrics (exported at the gateway's /metrics).
-_M_SUBMITTED = _REG.counter(
-    "scheduler_requests_total", "Requests submitted to the batch scheduler"
-)
-_M_DEPTH = _REG.gauge(
-    "scheduler_queue_depth", "Requests pending in the batch scheduler"
-)
-_M_OCCUPANCY = _REG.histogram(
-    "scheduler_batch_occupancy",
-    "Requests packed per executed scheduler batch",
-    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
-)
 
 
 @dataclass
@@ -70,6 +66,9 @@ class SchedulerConfig:
 class _Pending:
     request: GenerationRequest
     future: Future = field(default_factory=Future)
+    # Request-scoped trace captured at submit; the scheduler thread
+    # attaches its batch-execution span to it explicitly.
+    trace: object | None = None
 
 
 class BatchScheduler:
@@ -84,6 +83,9 @@ class BatchScheduler:
         self._ids = itertools.count()
         self._lock = threading.Lock()
         self._queue = self._make_queue()
+        # Liveness heartbeat: stamped per scheduler-loop iteration (the
+        # idle loop polls at 20 Hz) — the gateway readiness probe.
+        self._hb_tick = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="batch-scheduler", daemon=True
@@ -128,7 +130,7 @@ class BatchScheduler:
         """Enqueue one request; the Future resolves to GenerationResult."""
         if self._stop.is_set():
             raise RuntimeError("scheduler stopped")
-        pend = _Pending(request=request)
+        pend = _Pending(request=request, trace=_tracing.current_trace())
         with self._lock:
             rid = next(self._ids)
             self._pending[rid] = pend
@@ -145,9 +147,18 @@ class BatchScheduler:
 
     # ------------------------------------------------------------------
 
+    def heartbeat(self) -> dict:
+        """Scheduler-loop liveness (see ContinuousBatcher.heartbeat)."""
+        return {
+            "alive": self._thread.is_alive() and not self._stop.is_set(),
+            "last_tick_age_s": time.monotonic() - self._hb_tick,
+            "last_step_age_s": None,
+        }
+
     def _run(self) -> None:
         cfg = self.config
         while not self._stop.is_set():
+            self._hb_tick = time.monotonic()
             first = self._q_pop(timeout=0.05)
             if first is None:
                 continue
@@ -186,7 +197,13 @@ class BatchScheduler:
                 (p.max_new_tokens, p.top_k, p.top_p), []
             ).append((rid, pend))
         for (max_new, top_k, top_p), members in groups.items():
+            # Re-stamp per group: a legitimately long whole-batch
+            # program must not age the liveness tick like a wedge
+            # (the tick still ages DURING one group's device call —
+            # size the readiness threshold above the longest batch).
+            self._hb_tick = time.monotonic()
             reqs = [pend.request for _, pend in members]
+            t0 = time.perf_counter()
             try:
                 outs = self.engine.generate_texts(
                     [r.prompt for r in reqs],
@@ -203,7 +220,12 @@ class BatchScheduler:
                             BackendError(f"batch execution failed: {e}")
                         )
                 continue
+            dur = time.perf_counter() - t0
             for (_, pend), out in zip(members, outs):
+                if pend.trace is not None:
+                    pend.trace.add_span(
+                        "scheduler_batch", t0, dur, batch=len(members)
+                    )
                 pend.future.set_result(
                     GenerationResult(
                         text=out.text,
@@ -219,6 +241,10 @@ class ServingBackend(Backend):
 
     def __init__(self, scheduler: BatchScheduler):
         self.scheduler = scheduler
+
+    def health(self) -> dict:
+        """Gateway readiness probe surface: the scheduler heartbeat."""
+        return self.scheduler.heartbeat()
 
     async def generate_batch(
         self, requests: list[GenerationRequest]

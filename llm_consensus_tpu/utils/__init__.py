@@ -7,6 +7,27 @@ RUST_LOG-convention logging setup.
 """
 
 from llm_consensus_tpu.utils.logging import setup_logging
-from llm_consensus_tpu.utils.tracing import Tracer, span, trace_jax_profile
+from llm_consensus_tpu.utils.tracing import (
+    Trace,
+    Tracer,
+    TraceStore,
+    current_trace,
+    request_span,
+    span,
+    trace_jax_profile,
+    trace_store,
+    use_trace,
+)
 
-__all__ = ["Tracer", "setup_logging", "span", "trace_jax_profile"]
+__all__ = [
+    "Trace",
+    "Tracer",
+    "TraceStore",
+    "current_trace",
+    "request_span",
+    "setup_logging",
+    "span",
+    "trace_jax_profile",
+    "trace_store",
+    "use_trace",
+]

@@ -1,23 +1,48 @@
 """Span tracing + JAX profiler hooks.
 
 Tracing is ~absent in the reference (wall-clock only paces the readiness
-poll, ``src/main.rs:449-454``; SURVEY.md §5). Here:
+poll, ``src/main.rs:449-454``; SURVEY.md §5). Two layers here:
 
-- :class:`Tracer` / :func:`span` — lightweight wall-clock spans recorded
-  as structured events (name, start, duration, metadata), queryable and
-  dumpable to JSON; protocol phases (propose/evaluate/refine) and engine
-  phases (prefill/decode) report through this.
+- :class:`Tracer` / :func:`span` — lightweight flat wall-clock spans
+  recorded as structured events (name, start, duration, metadata),
+  queryable and dumpable to JSON; the engine's per-call instrumentation
+  reports through this. Bounded by a ring buffer (``max_records``,
+  evict-oldest) so a long-lived process cannot grow it without limit.
+- **Request-scoped traces** (PR 5) — :class:`TraceStore` /
+  :class:`Trace`: every gateway request gets a trace id at admission;
+  the id propagates through the serving stack via a
+  :mod:`contextvars` context (:func:`use_trace` /
+  :func:`current_trace` / :func:`request_span`), and worker threads
+  that cannot see the caller's context (the continuous batcher's host
+  loop) attach spans explicitly via :meth:`Trace.add_span`. The store
+  is a bounded ring of traces (evict-oldest), each trace a bounded
+  span tree; drops are counted and mirrored into the Prometheus
+  registry through :func:`set_drop_hook` (wired by
+  :mod:`llm_consensus_tpu.server.metrics` on import, so the two
+  surfaces move in lockstep). ``GET /debug/traces`` on the gateway
+  renders :meth:`Trace.to_dict` span trees.
 - :func:`trace_jax_profile` — context manager around
   ``jax.profiler.trace`` producing a TensorBoard-loadable device trace
-  for the real TPU hot loop.
+  for the real TPU hot loop; the gateway's ``X-Profile: 1`` header
+  (with ``serve --profile-dir``) drops one aligned with a request's
+  host spans.
+
+Process-wide tracing can be disabled entirely (:func:`set_enabled`,
+``serve --no-trace``): :meth:`TraceStore.start` then returns ``None``
+and every downstream call site degrades to a no-op — the knob the
+``bench.py --serve-trace-overhead`` A/B leg toggles.
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import threading
 import time
+import uuid
+from collections import OrderedDict, deque
+from contextvars import ContextVar
 from dataclasses import dataclass, field
 
 
@@ -30,10 +55,19 @@ class SpanRecord:
 
 
 class Tracer:
-    """Collects timed spans; thread-safe (backend calls run in threads)."""
+    """Collects timed spans; thread-safe (backend calls run in threads).
 
-    def __init__(self) -> None:
-        self._records: list[SpanRecord] = []
+    ``max_records`` bounds memory: the oldest span is evicted when the
+    ring is full, and :attr:`dropped` counts evictions (also mirrored
+    into the Prometheus drop counter via the module drop hook).
+    """
+
+    def __init__(self, max_records: int = 4096) -> None:
+        if max_records <= 0:
+            raise ValueError(f"max_records must be > 0, got {max_records}")
+        self.max_records = max_records
+        self._records: deque[SpanRecord] = deque()
+        self._dropped = 0
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -44,6 +78,10 @@ class Tracer:
         finally:
             dur = time.perf_counter() - t0
             with self._lock:
+                if len(self._records) >= self.max_records:
+                    self._records.popleft()
+                    self._dropped += 1
+                    _notify_drop("span", 1)
                 self._records.append(
                     SpanRecord(name=name, start=t0, duration=dur, meta=meta)
                 )
@@ -52,6 +90,11 @@ class Tracer:
     def records(self) -> list[SpanRecord]:
         with self._lock:
             return list(self._records)
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted from the ring (recorded-then-lost count)."""
+        return self._dropped
 
     def total(self, name: str) -> float:
         return sum(r.duration for r in self.records if r.name == name)
@@ -93,6 +136,326 @@ def span(name: str, **meta):
 
 def global_tracer() -> Tracer:
     return _GLOBAL
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped traces (PR 5)
+# ---------------------------------------------------------------------------
+
+# Process-wide enable switch. Disabled => TraceStore.start returns None
+# and request_span/use_trace degrade to no-ops; instrumentation sites
+# stay branch-free ("if trace is not None" is the whole protocol).
+_ENABLED = True
+
+# Mirror drops into the metrics registry without importing it here
+# (utils must stay below server in the layer order; server.metrics sets
+# the hook on import). Signature: (kind: "span" | "trace", n: int).
+_DROP_HOOK = None
+
+
+def set_enabled(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_drop_hook(hook) -> None:
+    global _DROP_HOOK
+    _DROP_HOOK = hook
+
+
+def _notify_drop(kind: str, n: int) -> None:
+    hook = _DROP_HOOK
+    if hook is not None and n:
+        try:
+            hook(kind, n)
+        except Exception:  # noqa: BLE001 - metrics must never break tracing
+            pass
+
+
+@dataclass
+class Span:
+    """One completed span in a trace (times relative to trace start)."""
+
+    span_id: int
+    name: str
+    start: float  # seconds since the trace began
+    duration: float
+    parent_id: int
+    meta: dict = field(default_factory=dict)
+
+
+class Trace:
+    """One request's bounded span tree; thread-safe.
+
+    Spans carry ids and parent ids; the tree is assembled lazily by
+    :meth:`to_dict`. The implicit ROOT span (``root_id``) is the trace
+    itself — ``name`` at offset 0, closed by :meth:`finish`. Spans past
+    ``max_spans`` are dropped (counted, hook-mirrored); a dropped
+    parent's surviving children re-attach to the root at render time.
+    """
+
+    def __init__(self, trace_id: str, name: str, max_spans: int, meta=None):
+        self.trace_id = trace_id
+        self.name = name
+        self.meta = dict(meta or {})
+        self.max_spans = max_spans
+        self.started_at = time.time()  # wall clock, for humans
+        self._t0 = time.perf_counter()  # monotonic origin of span offsets
+        self.root_id = 0
+        self._ids = itertools.count(1)
+        self._spans: list[Span] = []
+        self._dropped = 0
+        self._duration: float | None = None
+        self._lock = threading.Lock()
+
+    # -- recording ------------------------------------------------------
+
+    def next_id(self) -> int:
+        return next(self._ids)
+
+    def record(
+        self,
+        span_id: int,
+        name: str,
+        start_pc: float,
+        duration: float,
+        parent_id: int,
+        meta: dict | None = None,
+    ) -> None:
+        """Record a completed span; ``start_pc`` is a perf_counter stamp."""
+        sp = Span(
+            span_id=span_id,
+            name=name,
+            start=max(0.0, start_pc - self._t0),
+            duration=duration,
+            parent_id=parent_id,
+            meta=dict(meta or {}),
+        )
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self._dropped += 1
+                _notify_drop("span", 1)
+                return
+            self._spans.append(sp)
+
+    def add_span(
+        self,
+        name: str,
+        start_pc: float,
+        duration: float,
+        parent_id: int | None = None,
+        **meta,
+    ) -> None:
+        """Externally-timed span (worker threads that cannot use the
+        contextvar protocol); attaches to the root unless parented."""
+        self.record(
+            self.next_id(),
+            name,
+            start_pc,
+            duration,
+            self.root_id if parent_id is None else parent_id,
+            meta,
+        )
+
+    def finish(self, **meta) -> None:
+        """Close the root span (idempotent; first close wins)."""
+        with self._lock:
+            if self._duration is None:
+                self._duration = time.perf_counter() - self._t0
+            if meta:
+                self.meta.update(meta)
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def duration(self) -> float:
+        """Root duration: final after :meth:`finish`, else elapsed."""
+        d = self._duration
+        return d if d is not None else time.perf_counter() - self._t0
+
+    @property
+    def finished(self) -> bool:
+        return self._duration is not None
+
+    @property
+    def n_spans(self) -> int:
+        return len(self._spans)
+
+    @property
+    def dropped_spans(self) -> int:
+        return self._dropped
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def summary(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "name": self.name,
+            "started_at": self.started_at,
+            "duration_s": self.duration,
+            "finished": self.finished,
+            "n_spans": self.n_spans,
+            "dropped_spans": self._dropped,
+            **({"meta": self.meta} if self.meta else {}),
+        }
+
+    def to_dict(self) -> dict:
+        """The span TREE: root node (the trace) with nested children."""
+        spans = self.spans()
+        known = {s.span_id for s in spans}
+        children: dict[int, list[Span]] = {}
+        for s in sorted(spans, key=lambda s: s.start):
+            parent = s.parent_id if s.parent_id in known else self.root_id
+            children.setdefault(parent, []).append(s)
+
+        def node(s: Span) -> dict:
+            return {
+                "name": s.name,
+                "start_s": round(s.start, 6),
+                "duration_s": round(s.duration, 6),
+                **({"meta": s.meta} if s.meta else {}),
+                "children": [node(c) for c in children.get(s.span_id, ())],
+            }
+
+        return {
+            **self.summary(),
+            "spans": [node(s) for s in children.get(self.root_id, ())],
+        }
+
+
+class TraceStore:
+    """Bounded process-wide ring of request traces (evict-oldest)."""
+
+    def __init__(self, max_traces: int = 256, max_spans: int = 2048):
+        # Clamp: a 0/negative trace cap would make the evict-oldest
+        # walk popitem() an empty dict on the first start(); "retain
+        # ~nothing" is max_traces=1 (use set_enabled(False) / serve
+        # --no-trace to turn tracing off entirely).
+        self.max_traces = max(1, max_traces)
+        self.max_spans = max(0, max_spans)
+        self._traces: OrderedDict[str, Trace] = OrderedDict()
+        self._evicted = 0
+        self._lock = threading.Lock()
+
+    def configure(
+        self, max_traces: int | None = None, max_spans: int | None = None
+    ) -> None:
+        """Adjust the bounds (serve CLI knobs); applies to new traces,
+        and an over-full ring sheds down to the new cap immediately."""
+        with self._lock:
+            if max_traces is not None:
+                self.max_traces = max(1, max_traces)
+            if max_spans is not None:
+                self.max_spans = max(0, max_spans)
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+                self._evicted += 1
+                _notify_drop("trace", 1)
+
+    def start(self, name: str, **meta) -> Trace | None:
+        """Open (and retain) a new trace; ``None`` when tracing is off."""
+        if not _ENABLED:
+            return None
+        trace = Trace(
+            uuid.uuid4().hex[:16], name, max_spans=self.max_spans, meta=meta
+        )
+        with self._lock:
+            while len(self._traces) >= self.max_traces:
+                self._traces.popitem(last=False)
+                self._evicted += 1
+                _notify_drop("trace", 1)
+            self._traces[trace.trace_id] = trace
+        return trace
+
+    def get(self, trace_id: str) -> Trace | None:
+        with self._lock:
+            return self._traces.get(trace_id)
+
+    def discard(self, trace_id: str) -> None:
+        """Intentionally forget a trace (e.g. a request shed at the
+        admission door did no work worth retaining — under a 429 storm
+        these would otherwise churn the ring and evict the slow traces
+        being debugged). Not counted as a drop."""
+        with self._lock:
+            self._traces.pop(trace_id, None)
+
+    def traces(self, limit: int = 50) -> list[Trace]:
+        """Newest-first."""
+        with self._lock:
+            items = list(self._traces.values())
+        return items[::-1][: max(0, limit)]
+
+    @property
+    def evicted(self) -> int:
+        return self._evicted
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+_STORE = TraceStore()
+
+
+def trace_store() -> TraceStore:
+    return _STORE
+
+
+# Current (trace, span-id) of this context: tasks inherit it across
+# awaits, threads started via asyncio.to_thread inherit a copy, and
+# plain worker threads see None (they attach via Trace.add_span).
+_CTX: ContextVar[tuple[Trace, int] | None] = ContextVar(
+    "llm_consensus_trace", default=None
+)
+
+
+def current_trace() -> Trace | None:
+    ctx = _CTX.get()
+    return ctx[0] if ctx is not None else None
+
+
+@contextlib.contextmanager
+def use_trace(trace: Trace | None):
+    """Make ``trace`` the context's current trace (no-op for None)."""
+    if trace is None:
+        yield
+        return
+    token = _CTX.set((trace, trace.root_id))
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+@contextlib.contextmanager
+def request_span(name: str, **meta):
+    """Span on the context's current trace, nested under the context's
+    current span; a silent no-op when no trace is active (library code
+    can instrument unconditionally)."""
+    ctx = _CTX.get()
+    if ctx is None or not _ENABLED:
+        yield None
+        return
+    trace, parent = ctx
+    span_id = trace.next_id()
+    token = _CTX.set((trace, span_id))
+    t0 = time.perf_counter()
+    try:
+        yield trace
+    finally:
+        _CTX.reset(token)
+        trace.record(
+            span_id, name, t0, time.perf_counter() - t0, parent, meta
+        )
 
 
 @contextlib.contextmanager
