@@ -179,6 +179,7 @@ def _build_backend(args):
                 prefill_chunk=args.prefill_chunk,
                 share_prefix=not args.no_share_prefix,
                 host_cache_bytes=args.host_cache_mb << 20,
+                pipeline_depth=args.pipeline_depth,
             ),
             mesh=mesh,
         )
@@ -231,6 +232,15 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "MiB (0 = off) — evicted prefix-registry pages demote to host "
         "buffers and restore at the next same-prefix admission instead "
         "of re-prefilling",
+    )
+    p.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=2,
+        help="continuous backend: decode programs in flight at once — "
+        "the host loop enqueues program n+1 before fetching program "
+        "n's tokens, hiding scheduling work behind device compute "
+        "(1 = the serialized loop; outputs are identical either way)",
     )
     p.add_argument(
         "--cpu",

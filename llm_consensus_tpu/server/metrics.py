@@ -52,6 +52,8 @@ __all__ = [
     "GATEWAY_TTFT",
     "DECODE_STEP_SECONDS",
     "SCHED_OVERHEAD_SECONDS",
+    "PIPELINE_FLUSHES",
+    "DISPATCH_INFLIGHT",
     "TRACE_DROPPED",
     "PREFIX_PAGES_SHARED",
     "PREFIX_PAGES_COPIED",
@@ -517,12 +519,29 @@ DECODE_STEP_SECONDS = REGISTRY.histogram(
     "gateway_decode_step_seconds",
     "Continuous-batcher decode-step device latency (dispatch to fetch)",
 )
-#: Host time BETWEEN consecutive device decode steps — retirement,
-#: admission, prefill-chunk scheduling, group rebuilds. The scheduler
-#: overhead the decode roofline never shows; idle waits do not count.
+#: UN-OVERLAPPED host time per decode dispatch — retirement, admission,
+#: prefill-chunk scheduling, group rebuilds that no in-flight decode
+#: program hid. Under pipelined dispatch (PR 6, pipeline_depth > 1) a
+#: dispatch issued while a program is still in flight did its host work
+#: in that program's shadow and observes 0; at depth 1 this reduces to
+#: the classic host-gap-between-steps. The scheduler overhead the
+#: decode roofline never shows; idle waits do not count.
 SCHED_OVERHEAD_SECONDS = REGISTRY.histogram(
     "gateway_sched_overhead_seconds",
-    "Host time between consecutive decode steps (scheduling overhead)",
+    "Un-overlapped host time per decode dispatch (scheduling overhead)",
+)
+#: Pipelined decode dispatch (PR 6): decode programs dispatched but not
+#: yet token-fetched (0..pipeline_depth), and the drains forced by
+#: operations that need a stable cache underneath them (host-tier page
+#: restores, CoW boundary copies, legacy dense prefill). A flush-heavy
+#: workload is paying pipeline restarts for its admission pattern.
+DISPATCH_INFLIGHT = REGISTRY.gauge(
+    "gateway_dispatch_inflight",
+    "Decode programs dispatched but not yet fetched",
+)
+PIPELINE_FLUSHES = REGISTRY.counter(
+    "gateway_pipeline_flushes_total",
+    "Decode-pipeline drains before stable-cache operations",
 )
 #: Consensus protocol phase latency, labeled
 #: ``phase="propose"|"evaluate"|"refine"`` — one observation per phase

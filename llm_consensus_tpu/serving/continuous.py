@@ -46,6 +46,18 @@ style, re-founded on XLA's compile-once constraint:
   into the reserved NULL page and their outputs are discarded (the cost
   of a dead slot is one row of an already-batched matmul — negligible
   next to recompilation or bubbles).
+- **Pipelined decode dispatch** (PR 6, ``pipeline_depth``, default 2):
+  the host loop is a software pipeline, not a dispatch→sync→bookkeep
+  lockstep — program *n+1* is enqueued before program *n*'s tokens are
+  fetched, fed from *n*'s device-resident token output, so all host
+  work (stop scans, retirement, group bookkeeping, chunked-prefill
+  admission, host-tier restores) happens while the device is already
+  running the next program. Retirement lags by the in-flight depth
+  (overshoot tokens are discarded on fetch and pre-budgeted into page
+  reservations); restores, CoW boundary copies, and dense prefill
+  drain the pipeline first (``gateway_pipeline_flushes_total``).
+  Depth 1 is the serialized parity baseline; outputs are
+  byte-identical at every depth (tested).
 
 Pages for the whole request (prompt + max_new_tokens) are reserved at
 admission; requests wait while the pool is exhausted (no mid-flight
@@ -148,6 +160,12 @@ from llm_consensus_tpu.server.metrics import (
     SCHED_OVERHEAD_SECONDS as _M_SCHED_OVERHEAD,
 )
 from llm_consensus_tpu.server.metrics import (
+    PIPELINE_FLUSHES as _M_PIPELINE_FLUSHES,
+)
+from llm_consensus_tpu.server.metrics import (
+    DISPATCH_INFLIGHT as _M_DISPATCH_INFLIGHT,
+)
+from llm_consensus_tpu.server.metrics import (
     SERVING_ACTIVE as _M_ACTIVE,
 )
 from llm_consensus_tpu.server.metrics import (
@@ -229,6 +247,26 @@ class ContinuousConfig:
     # share_prefix + prefill_chunk > 0 (the restore path re-registers
     # pages under the registry's readiness gates).
     host_cache_bytes: int = 0
+    # Decode programs in flight at once (PR 6): the host loop enqueues
+    # program n+1 BEFORE fetching program n's tokens, feeding the next
+    # dispatch from the device-resident token output of the previous
+    # one (the cache already flows through donate_argnums), so the one
+    # true host sync of the loop lands while the next program is
+    # already running — stop scans, retirement, group bookkeeping,
+    # chunked-prefill admission, and host-tier restores all happen in
+    # that overlap window. Purely a host-loop restructuring: it
+    # engages on every backend, meshes included. Retirement lags
+    # dispatch by the in-flight depth (a finished row keeps decoding
+    # through the already-enqueued programs; the extra tokens are
+    # discarded on fetch and pre-budgeted into the page reservation —
+    # up to pipeline_depth * steps_per_sync - 1 overshoot tokens per
+    # sequence). Operations that want a stable cache + settled
+    # bookkeeping (host-tier restores, CoW boundary copies, dense
+    # prefill) DRAIN the pipeline first, counted in
+    # gateway_pipeline_flushes_total. 1 = the serialized
+    # dispatch->sync->bookkeep loop (the parity baseline); outputs are
+    # byte-identical at every depth (tested).
+    pipeline_depth: int = 2
 
 
 @dataclass
@@ -288,6 +326,24 @@ class _Slot:
     # Nodes THIS sequence registered, with the prompt position whose
     # write completes them: [(node, end_pos)].
     reg_nodes: list = field(default_factory=list)
+
+
+@dataclass
+class _Inflight:
+    """One dispatched, not-yet-fetched decode program (PR 6).
+
+    ``rows`` snapshots the (slot index, slot object) pairs that were
+    decoding at dispatch time: the fetch credits tokens ONLY to rows
+    whose slot object is still in place, so a slot retired — or retired
+    and re-admitted to a new request — while this program was in flight
+    never receives a stale program's output.
+    """
+
+    tokens: object  # device [slots, k] sampled tokens (the fetch target)
+    next_input: object  # device [slots] final token (next dispatch's input)
+    t0: float  # host dispatch stamp (perf_counter)
+    k: int  # decode steps folded into this program
+    rows: list  # [(slot_idx, _Slot)] decoding at dispatch
 
 
 class ContinuousBatcher:
@@ -410,6 +466,25 @@ class ContinuousBatcher:
         self._slots: list[_Slot | None] = [None] * c.max_slots
         self._waiting: deque[_Request] = deque()
         self._last_tokens = np.zeros((c.max_slots,), np.int32)
+        # Pipelined decode dispatch (PR 6): programs dispatched but not
+        # yet fetched (oldest first; bounded by pipeline_depth), and the
+        # rows whose next input token must come from the HOST mirror
+        # instead of the previous program's device output (rows
+        # (re)activated since the last dispatch — their first token was
+        # sampled from prefill logits, not decoded in flight).
+        self._inflight: deque[_Inflight] = deque()
+        self._tok_dirty = np.zeros((c.max_slots,), bool)
+        self._pipeline_flushes = 0
+        # perf_counter stamp of the previous fetch's completion: deeper
+        # than depth 1 a program starts on device when its predecessor
+        # finishes, not at its own dispatch — the step histogram uses
+        # max(dispatch, previous fetch) as the start approximation.
+        self._last_fetch_end: float | None = None
+        # CoW boundary copy staged by _admit_chunked under the lock,
+        # dispatched by _admit's post-lock epilogue (the copy wants a
+        # pipeline flush first, and the flush's fetch bookkeeping takes
+        # the same lock).
+        self._pending_copy: tuple[int, int] | None = None
         # Per-slot PRNG state: requests own their stream (seed, token
         # index), so sampling is reproducible regardless of batch-mates.
         self._seeds = np.zeros((c.max_slots,), np.int32)
@@ -463,6 +538,21 @@ class ContinuousBatcher:
         )
         self._thread.start()
 
+    @property
+    def _sync_chunk(self) -> int:
+        """Decode steps per dispatched device program (>= 1) — THE one
+        definition the decode program, the page-overshoot budget, and
+        the fetch accounting all share (three sites drifting
+        independently is how the overshoot budget breaks)."""
+        return max(1, self.config.steps_per_sync)
+
+    @property
+    def _depth(self) -> int:
+        """Decode programs allowed in flight (>= 1). Read per loop
+        iteration, so a depth change between bursts takes effect
+        without restarting the batcher (the bench's A/B lever)."""
+        return max(1, self.config.pipeline_depth)
+
     # -- device programs ------------------------------------------------
 
     def _decode_sample(
@@ -480,7 +570,9 @@ class ContinuousBatcher:
     ):
         """``steps_per_sync`` decode+sample steps as ONE device program.
 
-        Returns ``([slots, k] tokens, [slots, k] logprobs, cache)``.
+        Returns ``([slots, k] tokens, [slots, k] logprobs, cache,
+        [slots] final token)`` — the final-token row is what a pipelined
+        dispatch feeds the NEXT program without a host round trip.
         Each step folds ``(seed, count+j)`` into the per-slot PRNG —
         the same stream a chunk-of-1 loop would draw, so results are
         chunk-size-invariant (tested).
@@ -491,7 +583,7 @@ class ContinuousBatcher:
         variants are separate cached traces; membership CHANGES within
         a variant are pure data and never recompile).
         """
-        k = max(1, self.config.steps_per_sync)
+        k = self._sync_chunk
 
         def body(carry, _):
             cache, tok, cnt = carry
@@ -511,10 +603,10 @@ class ContinuousBatcher:
             )
             return (cache, next_tok, cnt + 1), (next_tok, logp)
 
-        (cache, _, _), (toks, logps) = jax.lax.scan(
+        (cache, tok_end, _), (toks, logps) = jax.lax.scan(
             body, (cache, tokens, counts), None, length=k
         )
-        return toks.T, logps.T, cache
+        return toks.T, logps.T, cache, tok_end
 
     def _prefill_fn(self, s_bucket: int):
         """Jitted per-bucket: prefill one prompt densely, scatter to pages.
@@ -707,6 +799,14 @@ class ContinuousBatcher:
                 "decode_step_seconds_count": self._decode_step_count,
                 "sched_overhead_seconds_sum": self._sched_overhead_sum,
                 "sched_overhead_seconds_count": self._sched_overhead_count,
+                # Pipelined decode dispatch (PR 6): programs currently
+                # dispatched-not-fetched, and drains forced by
+                # stable-cache operations (restores, CoW copies, dense
+                # prefill) — the same observations behind
+                # gateway_dispatch_inflight /
+                # gateway_pipeline_flushes_total (lockstep tested).
+                "dispatch_inflight": len(self._inflight),
+                "pipeline_flushes": self._pipeline_flushes,
             }
 
     def close(self) -> None:
@@ -756,16 +856,19 @@ class ContinuousBatcher:
         return self._table_pages(bucket, bucket, req)
 
     def _table_pages(self, bucket: int, prefill_end: int, req: _Request) -> int:
-        # + steps_per_sync - 1: a row finishing mid-chunk keeps writing
-        # K/V until the decode-chunk boundary (those tokens are
-        # discarded on host); its pages must absorb the overshoot.
+        # + depth * steps_per_sync - 1: a row finishing mid-chunk keeps
+        # writing K/V until the decode-chunk boundary, and under
+        # pipelined dispatch its retirement lags up to depth - 1 MORE
+        # already-enqueued programs (all those tokens are discarded on
+        # host); its pages must absorb the full overshoot. depth 1,
+        # chunk 1 reduces this to the classic + 0.
         # prefill_end: last position (+1) the chunked prefill may touch
         # — a shared-prefix start off the chunk grid can overhang the
         # bucket by up to chunk-1 positions of masked padding garbage.
         total = (
             max(bucket, prefill_end)
             + req.max_new_tokens
-            + max(1, self.config.steps_per_sync)
+            + self._depth * self._sync_chunk
             - 1
         )
         pg = self.config.page_size
@@ -813,6 +916,11 @@ class ContinuousBatcher:
                 # Legacy path: the dense prefill runs OUTSIDE the lock
                 # (device work must not block submit()).
                 self._dense_prefill_pending()
+            elif self._pending_copy is not None:
+                # The admission staged a CoW boundary copy: dispatch it
+                # outside the lock (flush-then-copy; _flush_pipeline's
+                # fetch bookkeeping takes the admission lock).
+                self._boundary_copy_pending()
 
     # -- admission: chunked + prefix-sharing path ------------------------
 
@@ -940,13 +1048,13 @@ class ContinuousBatcher:
                     # Copy-on-write: the donor's boundary page extends
                     # our prefix mid-page; copy its content into our
                     # first private page and resume prefill after the
-                    # common run.
+                    # common run. The device copy is STAGED here and
+                    # dispatched by _admit's post-lock epilogue (after
+                    # a pipeline flush — a stable-cache operation);
+                    # this slot's first chunk cannot run before it
+                    # (same worker thread, _prefill_step comes later).
                     _M_PREFIX_COPIED.inc()
-                    self.cache = self._jit_copy_page(
-                        self.cache,
-                        jnp.int32(match.boundary_page),
-                        jnp.int32(new_pages[0]),
-                    )
+                    self._pending_copy = (match.boundary_page, new_pages[0])
                 # Offer our own full prompt pages to successors
                 # (pending until our prefill writes past each page) —
                 # unless sharing is off: a registry nobody consults
@@ -996,6 +1104,40 @@ class ContinuousBatcher:
                 )
                 return True
         return False
+
+    def _boundary_copy_pending(self) -> None:
+        """Dispatch the CoW boundary copy staged by :meth:`_admit_chunked`
+        (outside the admission lock). Flushes the decode pipeline first:
+        the copy is a stable-cache operation, and draining also settles
+        retirement bookkeeping before the copy + first-chunk sequence
+        occupies the device queue."""
+        src, dst = self._pending_copy
+        self._pending_copy = None
+        self._flush_pipeline()
+        self.cache = self._jit_copy_page(
+            self.cache, jnp.int32(src), jnp.int32(dst)
+        )
+
+    def _flush_pipeline(self) -> None:
+        """Drain every in-flight decode program (fetch + bookkeeping).
+
+        The flush points are the operations that want a stable cache
+        and settled host bookkeeping underneath them: host-tier page
+        restores (install_page), CoW boundary copies, and legacy dense
+        prefill. Each drain of a non-empty pipeline counts once in
+        ``gateway_pipeline_flushes_total`` — the price the pipeline
+        pays to keep those paths simple. (Registry demotions read
+        pages with ``device_get``, which already blocks on the
+        dispatched stream and needs no flush.) Must be called WITHOUT
+        the admission lock: fetch bookkeeping takes it.
+        """
+        if not self._inflight:
+            return
+        _M_PIPELINE_FLUSHES.inc()
+        with self._lock:
+            self._pipeline_flushes += 1
+        while self._inflight:
+            self._fetch_one()
 
     def _demote_nodes(self, nodes) -> None:
         """PrefixRegistry.on_evict hook: spill an evict() walk's ready
@@ -1052,6 +1194,9 @@ class ContinuousBatcher:
         """
         if not self._restores:
             return False
+        # Stable-cache operation: drain in-flight decode programs
+        # before installing host content into a pool page.
+        self._flush_pipeline()
         node, planes, trace = self._restores.popleft()
         t0 = time.perf_counter()
         self.cache = self._jit_install_page(
@@ -1099,6 +1244,12 @@ class ContinuousBatcher:
             return False
         self._prefill_rr = (idx + 1) % n
         slot = self._slots[idx]
+        if self._inflight:
+            # Let in-flight decode work clear the device queue so the
+            # stall histogram times ONLY this chunk. A device-order
+            # wait, NOT a flush: the pending fetches stay pipelined
+            # and cost ~nothing afterwards.
+            jax.block_until_ready(self.cache.length)
         t0 = time.perf_counter()
         chunk_ids = slot.padded_ids[slot.next_pos : slot.next_pos + slot.chunk]
         hidden, self.cache = self._chunk_fn(slot.chunk, slot.s_bucket)(
@@ -1180,6 +1331,11 @@ class ContinuousBatcher:
         with self._lock:
             _M_ACTIVE.set(self._decoding())
         self._last_tokens[idx] = first
+        # The next dispatch must feed THIS row from the host mirror:
+        # its first token came from prefill logits, not from the
+        # in-flight program's output row (which is stale or garbage
+        # for a freshly (re)activated slot).
+        self._tok_dirty[idx] = True
         self._seeds[idx] = req.seed
         self._counts[idx] = 1  # token 0 sampled from prefill
         self._topks[idx] = req.top_k
@@ -1236,7 +1392,10 @@ class ContinuousBatcher:
         return True
 
     def _dense_prefill_pending(self) -> None:
-        """Blocking dense prefill for the slot staged by _admit_dense."""
+        """Blocking dense prefill for the slot staged by _admit_dense.
+        Flushes the decode pipeline first (stable-cache operation: the
+        whole-prompt prefill rewrites a slot's table and pages)."""
+        self._flush_pipeline()
         c = self.config
         idx = self._dense_pending
         slot = self._slots[idx]
@@ -1324,18 +1483,31 @@ class ContinuousBatcher:
                 )
             )
 
-    def _step(self) -> None:
+    def _dispatch(self) -> None:
+        """Enqueue ONE decode program for the current decode batch.
+
+        In pipelined mode (``pipeline_depth > 1``) this runs BEFORE the
+        previous program's tokens reach the host: the input token row is
+        the device-resident final-token output of the previous dispatch
+        (no host->device round trip on the input side; the cache already
+        flows through ``donate_argnums``), so the host's fetch and
+        bookkeeping for program *n* overlap program *n+1*'s device
+        execution. Rows (re)activated since the previous dispatch are
+        patched in from the host mirror (``_tok_dirty``).
+        """
         c = self.config
+        k = self._sync_chunk
         temps = np.zeros((c.max_slots,), np.float32)
+        rows_now: list[tuple[int, _Slot]] = []
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.phase == "decode":
                 temps[i] = slot.request.temperature
+                rows_now.append((i, slot))
         filters_active = any(
-            s is not None
-            and s.phase == "decode"
-            and (s.request.top_k != 0 or s.request.top_p != 1.0)
-            for s in self._slots
+            s.request.top_k != 0 or s.request.top_p != 1.0
+            for _, s in rows_now
         )
+
         def rows(x):
             arr = jnp.asarray(x)
             if self._row_sharding is not None:
@@ -1343,20 +1515,40 @@ class ContinuousBatcher:
             return arr
 
         groups = self._groups.arrays() if self._group_decode else None
-        # Host time since the previous step's fetch = scheduling
-        # overhead (retirement, admission, prefill chunks, group
-        # rebuilds); idle waits reset _last_step_end and never count.
         t0 = time.perf_counter()
+        # Un-overlapped host time: the gap since the pipeline drained
+        # (retirement, admission, prefill chunks, group rebuilds that
+        # no in-flight program hid). A dispatch issued with a program
+        # still in flight spent its host time in that program's shadow
+        # and observes 0, keeping depth-1 and depth-2 distributions
+        # count-comparable; idle waits reset _last_step_end and never
+        # count.
+        overhead = None
         if self._last_step_end is not None:
             overhead = t0 - self._last_step_end
+        elif self._inflight:
+            overhead = 0.0
+        if overhead is not None:
             _M_SCHED_OVERHEAD.observe(overhead)
             with self._lock:
                 self._sched_overhead_sum += overhead
                 self._sched_overhead_count += 1
-        next_tok, _, self.cache = self._jit_decode(
+        self._last_step_end = None
+        if self._inflight:
+            tokens = self._inflight[-1].next_input
+            if self._tok_dirty.any():
+                tokens = jnp.where(
+                    jnp.asarray(self._tok_dirty),
+                    jnp.asarray(self._last_tokens),
+                    tokens,
+                )
+        else:
+            tokens = rows(self._last_tokens)
+        self._tok_dirty[:] = False
+        next_tok, _, self.cache, next_in = self._jit_decode(
             self.params,
             self.cache,
-            rows(self._last_tokens),
+            tokens,
             rows(self._seeds),
             rows(self._counts),
             rows(temps),
@@ -1365,55 +1557,88 @@ class ContinuousBatcher:
             filters_active,
             groups,
         )
-        next_np = np.asarray(next_tok)  # [slots, k] — THE host sync
+        # Host counters track the DEVICE stream at dispatch: the
+        # program advances every participating row by k regardless of
+        # what the fetch later keeps, so a surviving row's next
+        # dispatch folds the right PRNG indices.
+        for i, _ in rows_now:
+            self._counts[i] += k
+        self._inflight.append(
+            _Inflight(
+                tokens=next_tok, next_input=next_in, t0=t0, k=k, rows=rows_now
+            )
+        )
+        _M_DISPATCH_INFLIGHT.set(len(self._inflight))
+        _M_GROUP_SIZE.set(
+            self._groups.largest_group if groups is not None else 0
+        )
+        if groups is not None:
+            # Shared pages read once per group instead of once per
+            # member: count the reads this program skips.
+            saved = (
+                self._groups.saved_tokens_per_step * self._kv_token_bytes * k
+            )
+            _M_KV_SAVED.inc(saved)
+            with self._lock:
+                self._kv_bytes_saved += saved
+
+    def _fetch_one(self) -> None:
+        """Fetch the OLDEST in-flight program's tokens and run its host
+        bookkeeping — stop scans, retirement, future resolution.
+
+        Retirement necessarily lags dispatch by the in-flight depth: a
+        row that finished in program *n* keeps decoding through the
+        already-enqueued programs *n+1..n+depth-1*. Those tokens are
+        discarded here — rows are credited by _Slot IDENTITY, so a slot
+        retired (or retired and re-admitted) since dispatch never sees
+        a stale program's output, and the stop-trim semantics stay
+        byte-identical to depth 1 — and the page overshoot is
+        pre-budgeted by :meth:`_table_pages`.
+        """
+        rec = self._inflight.popleft()
+        next_np = np.asarray(rec.tokens)  # [slots, k] — THE host sync
         step_end = time.perf_counter()
-        dur = step_end - t0
-        self._last_step_end = step_end
+        # Device-step latency: at depth 1 the program started at its
+        # own dispatch; deeper, it started when its predecessor
+        # finished — approximated from the host side by the previous
+        # fetch's completion.
+        start = rec.t0
+        if self._last_fetch_end is not None:
+            start = max(start, self._last_fetch_end)
+        dur = step_end - start
+        self._last_fetch_end = step_end
+        # The pipeline drained: host time from here to the next
+        # dispatch is un-overlapped. With programs still in flight the
+        # gap is hidden and the next dispatch observes 0.
+        self._last_step_end = step_end if not self._inflight else None
         self._hb_step = time.monotonic()
         _M_STEP_SECONDS.observe(dur)
-        k = max(1, self.config.steps_per_sync)
+        _M_DISPATCH_INFLIGHT.set(len(self._inflight))
+        alive = [(i, s) for i, s in rec.rows if self._slots[i] is s]
         with self._lock:
-            self._decode_steps += k
+            self._decode_steps += rec.k
             self._decode_step_sum += dur
             self._decode_step_count += 1
-            active = self._decoding()
-            if groups is not None:
-                # Shared pages read once per group instead of once per
-                # member: count the reads the device program skipped.
-                saved = (
-                    self._groups.saved_tokens_per_step
-                    * self._kv_token_bytes
-                    * k
-                )
-                self._kv_bytes_saved += saved
-        # One "decode_step" span per DISTINCT trace among the step's
-        # decoding slots: a batched step belongs to every request it
-        # advanced (the per-trace span budget bounds long decodes).
+        # One "decode_step" span per DISTINCT trace among the program's
+        # surviving participants: a batched step belongs to every
+        # request it advanced (the per-trace span budget bounds long
+        # decodes; retired requests take no post-retirement spans).
         step_traces: dict[int, object] = {}
-        for slot in self._slots:
-            if (
-                slot is not None
-                and slot.phase == "decode"
-                and slot.request.trace is not None
-            ):
+        for _, slot in alive:
+            if slot.request.trace is not None:
                 step_traces[id(slot.request.trace)] = slot.request.trace
         for tr in step_traces.values():
-            tr.add_span("decode_step", t0, dur, active=active, k=k)
-        _M_STEPS.inc(k)
-        _M_GROUP_SIZE.set(self._groups.largest_group if groups is not None else 0)
-        if groups is not None:
-            _M_KV_SAVED.inc(saved)
-        if active:
-            _M_OCCUPANCY.observe(active)
-        for i, slot in enumerate(self._slots):
-            if slot is None or slot.phase != "decode":
-                continue
-            # Device streams advanced k for every row; host counters
-            # must track the DEVICE stream, not the kept tokens, so a
-            # surviving row's next chunk folds the right PRNG indices.
-            self._counts[i] += k
+            # Same window as _M_STEP_SECONDS: [start, step_end], where
+            # start is the corrected dispatch/predecessor-fetch stamp.
+            tr.add_span(
+                "decode_step", start, dur, active=len(rec.rows), k=rec.k
+            )
+        _M_STEPS.inc(rec.k)
+        if rec.rows:
+            _M_OCCUPANCY.observe(len(rec.rows))
+        for i, slot in alive:
             done = False
-            for j in range(k):
+            for j in range(rec.k):
                 tok = int(next_np[i, j])
                 slot.generated.append(tok)
                 self._last_tokens[i] = tok
@@ -1423,8 +1648,8 @@ class ContinuousBatcher:
                     or self._hit_stop(slot)
                 )
                 if done:
-                    # Tokens past this point in the chunk were decoded
-                    # on device but never belonged to the request.
+                    # Tokens past this point were decoded on device
+                    # but never belonged to the request.
                     break
             if done:
                 self._retire(i)
@@ -1443,12 +1668,27 @@ class ContinuousBatcher:
             ):
                 progress = True
             if self._decoding():
-                self._step()
+                # Software pipeline: enqueue the next program FIRST,
+                # then fetch the oldest once the window is full — the
+                # fetch's host sync lands while the newer program(s)
+                # run. depth 1 reduces to dispatch -> fetch -> bookkeep
+                # (the serialized parity baseline); the while also
+                # drains excess depth after a live depth reduction.
+                self._dispatch()
+                while len(self._inflight) >= self._depth:
+                    self._fetch_one()
                 progress = True
             else:
-                # No device step ran: the gap to the next one is not
-                # scheduling overhead (the batch went empty).
-                self._last_step_end = None
+                if self._inflight:
+                    # The decode batch went empty (every known row
+                    # retired) with programs still in flight: drain
+                    # them — late retirements and futures resolve here.
+                    self._fetch_one()
+                    progress = True
+                if not self._decoding():
+                    # No device step pending: the gap to the next one
+                    # is not scheduling overhead.
+                    self._last_step_end = None
             if not progress:
                 self._last_step_end = None
                 self._work.wait(timeout=0.1)

@@ -708,12 +708,14 @@ def test_mesh_batcher_rejects_indivisible_shapes():
 
 
 def test_continuous_chunk_size_invariance():
-    """steps_per_sync is a pure throughput knob: chunk 1 and chunk 4
-    serve identical text for the same greedy AND sampled requests (the
-    per-token PRNG stream is (seed, index), independent of chunking)."""
+    """steps_per_sync AND pipeline_depth are pure throughput knobs:
+    chunk 1/4 x depth 1/2 all serve identical text for the same greedy
+    AND sampled requests (the per-token PRNG stream is (seed, index),
+    independent of how many steps ride one program or how many
+    programs ride in flight)."""
     params = _params()
 
-    def run(chunk):
+    def run(chunk, depth):
         b = ContinuousBatcher(
             CFG,
             params,
@@ -725,6 +727,7 @@ def test_continuous_chunk_size_invariance():
                 max_new_tokens=8,
                 seq_buckets=(16, 32, 64),
                 steps_per_sync=chunk,
+                pipeline_depth=depth,
             ),
         )
         try:
@@ -737,4 +740,7 @@ def test_continuous_chunk_size_invariance():
         finally:
             b.close()
 
-    assert run(1) == run(4)
+    want = run(1, 1)
+    assert run(4, 1) == want
+    assert run(1, 2) == want
+    assert run(4, 2) == want
