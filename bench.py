@@ -298,6 +298,29 @@ def main() -> int:
         "grid sweep (the PERF.md table)",
     )
     p.add_argument(
+        "--serve-ragged-attention",
+        action="store_true",
+        help="fused-scheduler-step A/B leg (PR 8): a prefill-heavy "
+        "MIXED burst (shared panel header + unique-prefix requests) "
+        "served through ONE batcher with ContinuousConfig."
+        "ragged_attention ON (a ready prefill chunk rides the decode "
+        "dispatch as one ragged-kernel row — ONE device program per "
+        "scheduler iteration) vs OFF (standalone chunk program + "
+        "decode program, the PR-7 state) — byte-identical text "
+        "REQUIRED per pair, reports tok/s per leg and device programs "
+        "per scheduler iteration (target 1.0 on the fused leg), plus "
+        "a pipeline depth {1,2} grid and a sliding-window parity "
+        "sub-leg; fails (rc 1) on text divergence or a fused-leg "
+        "ratio above 1",
+    )
+    p.add_argument(
+        "--ragged-ab-rounds",
+        type=int,
+        default=2,
+        help="alternating off/on paired rounds for "
+        "--serve-ragged-attention",
+    )
+    p.add_argument(
         "--serve-trace-overhead",
         action="store_true",
         help="observability A/B leg: the identical panel-shaped burst "
@@ -463,6 +486,8 @@ def main() -> int:
         return _bench_speculative(args, cfg, params, tokens, lengths)
     if args.serve_decode_pipeline:
         return _bench_serving_pipeline_ab(args, cfg, params)
+    if args.serve_ragged_attention:
+        return _bench_serving_ragged_ab(args, cfg, params)
     if args.serve_trace_overhead:
         return _bench_serving_trace_overhead(args, cfg, params)
     if args.serve_offload:
@@ -1112,6 +1137,271 @@ def _bench_serving_pipeline_ab(args, cfg, params) -> int:
             f"(mean {1e3 * ov1:.2f} -> {1e3 * ov2:.2f} ms, p50 "
             f"{1e3 * p50_1:.1f} -> {1e3 * p50_2:.1f} ms) — the overlap "
             "window is not engaging",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def _bench_serving_ragged_ab(args, cfg, params) -> int:
+    """Fused scheduler step A/B (PR 8): one ragged device program per
+    scheduler iteration vs the PR-7 "one chunk program + one decode
+    program" split.
+
+    The burst is PREFILL-HEAVY and MIXED on purpose: half the requests
+    share a panel header (prefix-registry hits — short chunked
+    prefills), half carry unique headers (registry misses — full
+    chunked prefills), all through one batcher with fewer slots than
+    requests, so admissions keep trickling in while earlier requests
+    decode and the scheduler constantly faces the chunk+decode
+    iteration the fusion targets. ``ragged_attention`` is host-loop
+    policy read per iteration, flipped between bursts on the idle
+    batcher (the pipeline-AB pattern).
+
+    Gates: per-pair byte-identical text (REQUIRED — the fused program
+    and the ragged kernel are pure restructurings), fused-leg device
+    programs per scheduler iteration == 1.0 (counted via
+    gateway_device_programs_total / the work-iteration denominator),
+    and the unfused leg ratio > 1 (the burst really exercised
+    concurrent prefill+decode — otherwise the A/B proved nothing).
+    tok/s is reported per leg (informational: on the 1-core CPU box
+    host and device share the core; the chip rows land with the next
+    bench round). A pipeline-depth {1,2} grid repeats the parity
+    check, and a sliding-window sub-leg re-runs it on a windowed
+    config — the configs that used to FALL BACK out of the grouped
+    kernel now ride the same ragged program.
+    """
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    n = args.serve_requests
+    longest = header_target + 64
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    chunk = args.serve_prefill_chunk or 64
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], args.new_tokens, args.serve_chunk, pg
+    )
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2
+    header = f"Panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+
+    def make_batcher(model_cfg):
+        return ContinuousBatcher(
+            model_cfg,
+            params,
+            config=ContinuousConfig(
+                max_slots=args.serve_slots,
+                page_size=pg,
+                n_pages=n_pages,
+                pages_per_seq=pages_per_seq,
+                max_new_tokens=args.new_tokens,
+                seq_buckets=tuple(buckets),
+                steps_per_sync=args.serve_chunk,
+                prefill_chunk=chunk,
+                share_prefix=True,
+            ),
+        )
+
+    def mixed_prompts(tag):
+        # Half panel-shaped (shared header, registry hits), half
+        # unique-header (full chunked prefills) — the mixed load whose
+        # chunk+decode iterations the fusion collapses.
+        out = []
+        for i in range(n):
+            if i % 2 == 0:
+                out.append(header + f"Q{tag}-{i}: item {i * 37 % 101}?")
+            else:
+                out.append(
+                    f"Unique header {salt}-{tag}-{i}: "
+                    + f"context {i} " * (-(-header_target // 11))
+                    + "tail?"
+                )
+        return out
+
+    def quiesce(batcher, timeout=10.0):
+        """Wait until the scheduler loop is fully idle — the previous
+        burst's futures resolve at fetch time, but the loop can still
+        be draining in-flight programs and overshoot steps; reading
+        the program/iteration counters across that tail would smear a
+        few iterations into the wrong leg."""
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < timeout:
+            s = batcher.stats()
+            if (
+                s["active_slots"] == 0
+                and s["prefilling_slots"] == 0
+                and s["dispatch_inflight"] == 0
+                and s["waiting"] == 0
+            ):
+                return
+            time.sleep(0.01)
+        # A leg boundary read over a still-draining batcher smears
+        # counters between legs — the gate would be meaningless.
+        raise RuntimeError(
+            f"batcher did not quiesce within {timeout}s "
+            f"(stats: {batcher.stats()})"
+        )
+
+    def leg(batcher, ragged, prompts):
+        """One burst; returns (texts, tok/s, programs-per-iteration)."""
+        batcher.config.ragged_attention = ragged
+        quiesce(batcher)
+        s0 = batcher.stats()
+        t0 = time.perf_counter()
+        futs = [
+            batcher.submit(p, max_new_tokens=args.new_tokens)
+            for p in prompts
+        ]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        quiesce(batcher)
+        s1 = batcher.stats()
+        programs = sum(
+            s1[k] - s0[k]
+            for k in (
+                "device_programs_fused",
+                "device_programs_decode",
+                "device_programs_prefill",
+            )
+        )
+        iters = s1["work_iterations"] - s0["work_iterations"]
+        toks = sum(r.num_tokens for r in results)
+        return (
+            [r.text for r in results],
+            toks / wall,
+            programs / max(1, iters),
+        )
+
+    runs = {False: [], True: []}  # ragged -> [(tok/s, ratio)]
+    diverged = False
+    batcher = make_batcher(cfg)
+    try:
+        batcher.submit(
+            header + "warmup tail", max_new_tokens=args.new_tokens
+        ).result(timeout=600)
+        # A CONCURRENT warmup burst compiles the fused program family
+        # too (a chunk only rides a dispatch when rows are decoding) —
+        # otherwise the first fused leg times XLA compilation.
+        for ragged in (True, False):
+            batcher.config.ragged_attention = ragged
+            futs = [
+                batcher.submit(
+                    header + f"warm {ragged} {i}",
+                    max_new_tokens=args.new_tokens,
+                )
+                for i in range(min(4, n))
+            ]
+            for f in futs:
+                f.result(timeout=600)
+        for r in range(max(1, args.ragged_ab_rounds)):
+            prompts = mixed_prompts(f"r{r}")
+            order = (False, True) if r % 2 == 0 else (True, False)
+            got = {}
+            for ragged in order:
+                texts, tps, ratio = leg(batcher, ragged, prompts)
+                got[ragged] = texts
+                runs[ragged].append((tps, ratio))
+            if got[False] != got[True]:
+                diverged = True
+        # Pipeline-depth grid: the fused fetch-side bookkeeping must
+        # stay byte-identical under the PR-6 overlap window.
+        grid_cells = []
+        grid_ok = True
+        grid_prompts = mixed_prompts("g")
+        grid_texts = None
+        for depth in (1, 2):
+            batcher.config.pipeline_depth = depth
+            for ragged in (False, True):
+                texts, tps, ratio = leg(batcher, ragged, grid_prompts)
+                grid_cells.append(
+                    f"d{depth}/{'on' if ragged else 'off'} {tps:.0f} tok/s "
+                    f"prog/iter {ratio:.2f}"
+                )
+                if grid_texts is None:
+                    grid_texts = texts
+                elif texts != grid_texts:
+                    grid_ok = False
+        batcher.config.pipeline_depth = 2
+    finally:
+        batcher.close()
+
+    # Sliding-window sub-leg: the config that used to fall back out of
+    # the grouped kernel entirely — same parity contract, same kernel.
+    win_ok = True
+    win_note = ""
+    if cfg.sliding_window == 0:
+        win_cfg = cfg.with_(sliding_window=96)
+        wb = make_batcher(win_cfg)
+        try:
+            wb.submit(
+                header + "win warmup", max_new_tokens=args.new_tokens
+            ).result(timeout=600)
+            wprompts = mixed_prompts("w")[: max(4, n // 2)]
+            wtexts = {}
+            for ragged in (False, True):
+                wtexts[ragged], _, wratio = leg(wb, ragged, wprompts)
+            win_ok = wtexts[False] == wtexts[True]
+            win_note = (
+                f", window96 text equal={win_ok} "
+                f"(fused prog/iter {wratio:.2f})"
+            )
+        finally:
+            wb.close()
+
+    best_off = max(t for t, _ in runs[False])
+    best_on = max(t for t, _ in runs[True])
+    # Fused leg: WORST round gates (max — target is 1.0, higher means
+    # a round where the fusion failed to engage; one good round must
+    # not mask it). Unfused leg: ANY round above 1.0 is the sizing
+    # evidence we need (the burst really produced chunk+decode
+    # iterations) — scheduler timing can serialize an individual round
+    # on a loaded box, which is noise, not a regression.
+    ratio_on = max(r for _, r in runs[True])
+    ratio_off = max(r for _, r in runs[False])
+    _emit(
+        {
+            "metric": f"serving tok/s, fused ragged scheduler step "
+            f"({cfg.name}, {len(runs[True])}x{n} mixed reqs, "
+            f"slots={args.serve_slots}, decode {args.new_tokens} @ "
+            f"~{header_target} prompts, chunk={chunk}, "
+            f"programs/iteration {ratio_off:.2f} -> {ratio_on:.2f}, "
+            f"unfused best {best_off:.0f} tok/s, "
+            f"text unchanged={not diverged}, "
+            f"grid[{'; '.join(grid_cells)}], grid text equal={grid_ok}"
+            f"{win_note})",
+            "value": round(best_on, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(best_on / max(best_off, 1e-9), 4),
+        },
+        args.out,
+    )
+    if diverged or not grid_ok or not win_ok:
+        print(
+            "[bench] GENERATED TEXT DIVERGED between ragged_attention "
+            "on/off — fused-step regression",
+            file=sys.stderr,
+        )
+        return 1
+    if ratio_on > 1.0 + 1e-9:
+        print(
+            f"[bench] fused leg ran {ratio_on:.3f} device programs per "
+            "scheduler iteration (target 1.0) — fusion not engaging",
+            file=sys.stderr,
+        )
+        return 1
+    if ratio_off <= 1.0:
+        print(
+            "[bench] unfused leg never hit a chunk+decode iteration "
+            f"(programs/iteration {ratio_off:.3f}) — the burst did not "
+            "exercise the fusion; resize the leg",
             file=sys.stderr,
         )
         return 1

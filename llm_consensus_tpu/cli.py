@@ -180,6 +180,7 @@ def _build_backend(args):
                 share_prefix=not args.no_share_prefix,
                 host_cache_bytes=args.host_cache_mb << 20,
                 pipeline_depth=args.pipeline_depth,
+                ragged_attention=not args.no_ragged_attention,
             ),
             mesh=mesh,
         )
@@ -232,6 +233,14 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "MiB (0 = off) — evicted prefix-registry pages demote to host "
         "buffers and restore at the next same-prefix admission instead "
         "of re-prefilling",
+    )
+    p.add_argument(
+        "--no-ragged-attention",
+        action="store_true",
+        help="continuous backend: disable the fused scheduler step "
+        "(PR 8) — prefill chunks run as standalone device programs "
+        "between decode steps instead of riding the decode dispatch "
+        "as ragged-kernel rows (outputs are identical either way)",
     )
     p.add_argument(
         "--pipeline-depth",

@@ -133,7 +133,8 @@ def set_stacked_decode(enabled: bool) -> None:
 
 
 def _attn_decode_quant_stacked(
-    cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len, layer
+    cfg: ModelConfig, q, k_q, k_s, v_q, v_s, valid_len, layer,
+    shared_prefix_len=None,
 ):
     """Decode attention over ONE layer of the stacked int8 cache.
 
@@ -141,11 +142,24 @@ def _attn_decode_quant_stacked(
     traced index. The Pallas path reads the stack in place (scalar
     prefetch); the jnp fallback slices the layer (XLA fuses the slice
     into the dequant + einsum).
+
+    ``shared_prefix_len`` (traced scalar or None): the shared-prefill
+    fan-out invariant now engages HERE too — the ragged kernel's
+    stacked layout reads the common prefix once for the whole batch
+    (the stacked-decode fallback PR 3 documented is gone).
     """
     use_kernel = (
         cfg.use_pallas and jax.device_count() == 1 and cfg.sliding_window == 0
     )
     if use_kernel:
+        if shared_prefix_len is not None:
+            from llm_consensus_tpu.ops.pallas import (
+                flash_decode_attention_shared_prefix_q8_stacked,
+            )
+
+            return flash_decode_attention_shared_prefix_q8_stacked(
+                q, k_q, k_s, v_q, v_s, valid_len, shared_prefix_len, layer
+            )
         from llm_consensus_tpu.ops.pallas import (
             flash_decode_attention_q8_stacked,
         )
@@ -569,7 +583,8 @@ def _block(
                 vs_f = vs_f.at[layer_idx, batch_idx, :, valid_len].set(vs1)
             new_kv = (kq_f, vq_f, ks_f, vs_f)
             attn = _attn_decode_quant_stacked(
-                cfg, q, kq_f, ks_f, vq_f, vs_f, valid_len + 1, layer_idx
+                cfg, q, kq_f, ks_f, vq_f, vs_f, valid_len + 1, layer_idx,
+                shared_prefix_len=shared_prefix_len,
             )
         elif len(kv_layer) == 2:
             k_l, v_l = kv_layer
@@ -759,6 +774,7 @@ def _run_layers(
                 positions,
                 uniform_write=uniform_write,
                 mesh=mesh,
+                shared_prefix_len=shared_prefix_len,
             )
             return (y, *new_leaves), None
         layer_kv = tuple(
@@ -982,6 +998,59 @@ def prefill(
     return logits, cache.with_length(lengths)
 
 
+def _attn_paged(
+    cfg: ModelConfig,
+    q_dec,
+    q_chunk,
+    k_pool,
+    v_pool,
+    tables,
+    valid,
+    chunk_table=None,
+    chunk_start=None,
+    groups=None,
+):
+    """Paged attention for one layer's decode rows (+ optional prefill
+    chunk row) — THE kernel-selection seam of the serving stack, and
+    deliberately a short one: ``cfg.use_pallas`` picks the ragged
+    kernel, anything else the XLA gather reference with identical
+    ragged semantics. Window, groups, and mixed rows are all cases of
+    the one kernel — the old per-feature fallback matrix is gone (mesh
+    stays a caller-level fallback: pallas_call is opaque to GSPMD).
+
+    q_dec: [B, H, D]; q_chunk: [C, H, D] or None; returns out_dec
+    [B, H, D] (and out_chunk [C, H, D] when q_chunk is given).
+    """
+    window = cfg.sliding_window
+    if cfg.use_pallas:
+        from llm_consensus_tpu.ops.pallas.attention import (
+            ragged_paged_attention,
+        )
+
+        gtuple = None
+        if groups is not None:
+            gtuple = (
+                groups.group_id,
+                groups.group_rep,
+                groups.group_pages.astype(jnp.int32) * k_pool.shape[1],
+                groups.shared_start,
+            )
+        return ragged_paged_attention(
+            q_dec, k_pool, v_pool, tables, valid,
+            q_chunk=q_chunk, chunk_table=chunk_table,
+            chunk_start=chunk_start, groups=gtuple, window=window,
+        )
+    from llm_consensus_tpu.ops.attention import (
+        ragged_paged_attention_reference,
+    )
+
+    return ragged_paged_attention_reference(
+        q_dec, k_pool, v_pool, tables, valid,
+        q_chunk=q_chunk, chunk_table=chunk_table, chunk_start=chunk_start,
+        window=window,
+    )
+
+
 def decode_step_paged(
     cfg: ModelConfig,
     params: dict,
@@ -1000,13 +1069,14 @@ def decode_step_paged(
     ``groups`` (a :class:`~llm_consensus_tpu.models.paged_cache.
     DecodeGroupArrays` or None): sequences sharing a prefix page run
     (the PrefixRegistry's CoW mappings) attend that run through the
-    group-aware kernel — one HBM read of the shared pages per GROUP per
-    step instead of one per member, with per-row suffix pages read as
-    before and the two partial softmaxes merged exactly. Grouped and
-    ungrouped rows coexist in the one program (ungrouped rows carry
-    group_id -1). Engages on the Pallas non-windowed path only; the jnp
-    gather path and sliding-window configs ignore ``groups`` (outputs
-    are identical either way — the callers' parity contract).
+    ragged kernel's group phase — one HBM read of the shared pages per
+    GROUP per step instead of one per member, with per-row suffix pages
+    read as before and the two partial softmaxes merged exactly.
+    Grouped and ungrouped rows coexist in the one program (ungrouped
+    rows carry group_id -1), and sliding-window configs group too (the
+    window is per-row masking in the same kernel — the old fallback is
+    gone). The jnp gather path ignores ``groups`` (outputs are
+    identical either way — the callers' parity contract).
     """
     from llm_consensus_tpu.models.paged_cache import PagedKVCache
 
@@ -1021,16 +1091,6 @@ def decode_step_paged(
     offset = pos % pg
     tables = cache.page_table  # [B, P]
 
-    # The paged Pallas kernel walks each row's pages through the
-    # scalar-prefetched table (only real pages stream to VMEM); the jnp
-    # path materializes k_pool[tables] — every row's full padded
-    # sequence — per layer per step. Sliding-window configs (Mistral)
-    # apply the same window rule inside the kernel.
-    use_paged_kernel = cfg.use_pallas
-    use_grouped = (
-        use_paged_kernel and groups is not None and cfg.sliding_window == 0
-    )
-
     def body(carry, layer_in):
         p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
         h = _rms(cfg, carry, p["attn_norm"])
@@ -1039,35 +1099,10 @@ def decode_step_paged(
         k = apply_rope(k, cos, sin)
         k_pool = k_pool.at[pages_now, offset].set(k[:, 0].astype(k_pool.dtype))
         v_pool = v_pool.at[pages_now, offset].set(v[:, 0].astype(v_pool.dtype))
-        if use_grouped:
-            from llm_consensus_tpu.ops.pallas.attention import (
-                paged_decode_attention_grouped,
-            )
-
-            attn = paged_decode_attention_grouped(
-                q[:, 0], k_pool, v_pool, tables, pos + 1,
-                groups.group_id, groups.group_rep, groups.group_pages,
-                groups.shared_start,
-            )[:, None]  # [B, H, D] -> [B, 1, H, D]
-        elif use_paged_kernel:
-            from llm_consensus_tpu.ops.pallas.attention import (
-                paged_decode_attention,
-            )
-
-            attn = paged_decode_attention(
-                q[:, 0], k_pool, v_pool, tables, pos + 1,
-                window=cfg.sliding_window,
-            )[:, None]  # [B, H, D] -> [B, 1, H, D] (seq axis restored)
-        else:
-            k_seq = k_pool[tables].reshape(
-                b, -1, cfg.n_kv_heads, cfg.head_dim
-            )
-            v_seq = v_pool[tables].reshape(
-                b, -1, cfg.n_kv_heads, cfg.head_dim
-            )
-            attn = decode_attention(
-                q, k_seq, v_seq, pos + 1, window=cfg.sliding_window
-            )
+        attn = _attn_paged(
+            cfg, q[:, 0], None, k_pool, v_pool, tables, pos + 1,
+            groups=groups,
+        )[:, None]  # [B, H, D] -> [B, 1, H, D] (seq axis restored)
         y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
         y = y + _mlp(cfg, p, h2)
@@ -1128,7 +1163,6 @@ def prefill_chunk_paged(
     pg = cache.page_size
     pages = table[pos // pg]  # [C] destination page per chunk token
     offs = pos % pg
-    valid = start[None]  # [1] pre-chunk fill for ragged-causal masking
 
     def body(carry, layer_in):
         p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
@@ -1138,14 +1172,24 @@ def prefill_chunk_paged(
         k = apply_rope(k, cos, sin)
         k_pool = k_pool.at[pages, offs].set(k[0].astype(k_pool.dtype))
         v_pool = v_pool.at[pages, offs].set(v[0].astype(v_pool.dtype))
-        # Flattened table gather: slot j of the [P*page] axis IS
-        # absolute position j (table[i] holds positions [i*pg, (i+1)*pg)),
-        # exactly the layout chunk_decode_attention's ragged rule masks.
-        k_seq = k_pool[table].reshape(1, -1, cfg.n_kv_heads, cfg.head_dim)
-        v_seq = v_pool[table].reshape(1, -1, cfg.n_kv_heads, cfg.head_dim)
-        attn = chunk_decode_attention(
-            q, k_seq, v_seq, valid, window=cfg.sliding_window
-        )
+        # Chunk-only ragged call through the SAME kernel seam as the
+        # fused step (one dead decode row: NULL table, valid 0) — a
+        # standalone chunk and a fused chunk must write bit-identical
+        # cache bytes, which means one attention arithmetic for both
+        # (on use_pallas configs the kernel and the XLA reference only
+        # agree to tolerance, so mixing them would break the
+        # ragged_attention on/off byte-parity contract mid-prefill).
+        attn = _attn_paged(
+            cfg,
+            jnp.zeros((1, cfg.n_heads, cfg.head_dim), q.dtype),
+            q[0],
+            k_pool,
+            v_pool,
+            jnp.zeros((1, table.shape[0]), jnp.int32),
+            jnp.zeros((1,), jnp.int32),
+            chunk_table=table,
+            chunk_start=start,
+        )[1][None]  # out_chunk [C, H, D] -> [1, C, H, D]
         y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
         y = y + _mlp(cfg, p, h2)
@@ -1158,6 +1202,101 @@ def prefill_chunk_paged(
         k=new_k, v=new_v, page_table=cache.page_table, length=cache.length
     )
     return x, new_cache
+
+
+def fused_step_paged(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    cache,
+    chunk_tokens: jnp.ndarray,
+    chunk_table: jnp.ndarray,
+    chunk_start: jnp.ndarray,
+    groups=None,
+    cfg_chunk: ModelConfig | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, object]:
+    """One decode step for every cache sequence PLUS one prefill chunk
+    — a single device program (the fused scheduler step).
+
+    tokens: [B, 1] decode inputs; chunk_tokens: [1, C] one sequence's
+    prompt chunk at absolute positions ``chunk_start + i``, written
+    through the explicit host-side ``chunk_table`` [P] exactly as
+    :func:`prefill_chunk_paged` (the mid-prefill row stays invisible to
+    the decode rows — its device table row is still NULL). The decode
+    rows and the chunk share ONE token axis: embedding, RoPE, the
+    QKV/WO/MLP matmuls, and the K/V pool scatter all run over the
+    [B + C] concatenation (bigger GEMMs, one scatter), and attention is
+    the ragged kernel with the chunk riding as one more row — chunked
+    prefill stops being a separate device program serializing against
+    decode.
+
+    The two workloads are independent by construction: decode rows
+    write only their own private pages, the chunk writes only positions
+    >= ``chunk_start`` of its own table (shared prefix pages are read,
+    never written), so each side's outputs equal the split programs'.
+    ``cfg_chunk`` (default ``cfg``): the MoE-pinned config the
+    standalone chunk program would have used — when it differs (MoE
+    configs), the MLP runs split per side so each side's dispatch path
+    matches its parity baseline; dense models share one MLP call.
+
+    Returns (decode logits [B, V] fp32, chunk hidden [1, C, D], cache).
+    ``cache.length`` advances for the decode rows only.
+    """
+    from llm_consensus_tpu.models.paged_cache import PagedKVCache
+
+    if cfg_chunk is None:
+        cfg_chunk = cfg
+    b = tokens.shape[0]
+    c = chunk_tokens.shape[1]
+    pos = cache.length  # [B] decode write positions
+    chunk_pos = chunk_start + jnp.arange(c)  # [C] absolute positions
+    all_pos = jnp.concatenate([pos, chunk_pos])
+    x = params["embed"][
+        jnp.concatenate([tokens[:, 0], chunk_tokens[0]])
+    ][None]  # [1, B+C, D]
+    cos, sin = rope_cos_sin(
+        all_pos[None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+    pg = cache.page_size
+    pages_dec = cache.page_table[jnp.arange(b), pos // pg]  # [B]
+    all_pages = jnp.concatenate([pages_dec, chunk_table[chunk_pos // pg]])
+    all_offs = all_pos % pg
+    tables = cache.page_table
+    mlp_split = cfg.is_moe and cfg_chunk is not cfg
+
+    def body(carry, layer_in):
+        p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
+        h = _rms(cfg, carry, p["attn_norm"])
+        q, k, v = _project_qkv(cfg, p, h)  # [1, B+C, H, Dh]
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pool = k_pool.at[all_pages, all_offs].set(k[0].astype(k_pool.dtype))
+        v_pool = v_pool.at[all_pages, all_offs].set(v[0].astype(v_pool.dtype))
+        attn_dec, attn_ch = _attn_paged(
+            cfg, q[0, :b], q[0, b:], k_pool, v_pool, tables, pos + 1,
+            chunk_table=chunk_table, chunk_start=chunk_start, groups=groups,
+        )
+        attn = jnp.concatenate([attn_dec, attn_ch])[None]  # [1, B+C, H, Dh]
+        y = carry + _qmm(attn.reshape(1, b + c, -1), p["wo"])
+        h2 = _rms(cfg, y, p["mlp_norm"])
+        if mlp_split:
+            y = y + jnp.concatenate(
+                [_mlp(cfg, p, h2[:, :b]), _mlp(cfg_chunk, p, h2[:, b:])],
+                axis=1,
+            )
+        else:
+            y = y + _mlp(cfg, p, h2)
+        return y, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    logits = _unembed(cfg, params, x[0, :b])
+    hidden_chunk = x[:, b:]  # [1, C, D]
+    new_cache = PagedKVCache(
+        k=new_k, v=new_v, page_table=cache.page_table, length=pos + 1
+    )
+    return logits, hidden_chunk, new_cache
 
 
 def unembed_one(cfg: ModelConfig, params: dict, h: jnp.ndarray) -> jnp.ndarray:

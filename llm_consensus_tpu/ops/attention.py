@@ -265,6 +265,55 @@ def decode_attention_shared_prefix_quant(
     )
 
 
+def ragged_paged_attention_reference(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    *,
+    q_chunk: jnp.ndarray | None = None,
+    chunk_table: jnp.ndarray | None = None,
+    chunk_start=None,
+    window: int = 0,
+):
+    """XLA reference for the ragged paged attention kernel — the parity
+    oracle and the non-Pallas serving path.
+
+    Same ragged semantics as
+    :func:`llm_consensus_tpu.ops.pallas.ragged_paged_attention`,
+    composed from the gather-then-attend references: decode rows
+    materialize their tables out of the pool and apply
+    :func:`decode_attention`'s one-token rule; the optional
+    prefill-chunk row (``q_chunk`` [C, H, D], queries at absolute
+    positions ``chunk_start + i`` through ``chunk_table`` [P]) applies
+    :func:`chunk_decode_attention`'s ragged-causal rule. Shared-prefix
+    groups are a pure bandwidth optimization in the kernel and do not
+    exist here — the kernel's grouped output must match this ungrouped
+    math (the PR 3 contract, extended to mixed rows).
+
+    q: [B, H, D]; k_pool/v_pool: [n_pages, page, Hkv, D]; page_table:
+    [B, P]; valid_len: [B]. Returns out_dec [B, H, D] (and out_chunk
+    [C, H, D] when ``q_chunk`` is given).
+    """
+    b, h, d = q.shape
+    hkv = k_pool.shape[2]
+    k_seq = k_pool[page_table].reshape(b, -1, hkv, d)
+    v_seq = v_pool[page_table].reshape(b, -1, hkv, d)
+    out = decode_attention(q[:, None], k_seq, v_seq, valid_len, window=window)[
+        :, 0
+    ]
+    if q_chunk is None:
+        return out
+    kc = k_pool[chunk_table].reshape(1, -1, hkv, d)
+    vc = v_pool[chunk_table].reshape(1, -1, hkv, d)
+    start = jnp.asarray(chunk_start, jnp.int32).reshape(1)
+    out_chunk = chunk_decode_attention(
+        q_chunk[None], kc, vc, start, window=window
+    )[0]
+    return out, out_chunk
+
+
 def chunk_decode_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,

@@ -19,8 +19,10 @@ from llm_consensus_tpu.ops.pallas.attention import (
     flash_decode_attention_q8_stacked,
     flash_decode_attention_shared_prefix,
     flash_decode_attention_shared_prefix_q8,
+    flash_decode_attention_shared_prefix_q8_stacked,
     paged_decode_attention,
     paged_decode_attention_grouped,
+    ragged_paged_attention,
 )
 from llm_consensus_tpu.ops.pallas.norms import fused_rms_norm
 from llm_consensus_tpu.ops.pallas.quant_matmul import quant_matmul_2d
@@ -32,8 +34,10 @@ __all__ = [
     "flash_decode_attention_q8_stacked",
     "flash_decode_attention_shared_prefix",
     "flash_decode_attention_shared_prefix_q8",
+    "flash_decode_attention_shared_prefix_q8_stacked",
     "paged_decode_attention",
     "paged_decode_attention_grouped",
+    "ragged_paged_attention",
     "fused_rms_norm",
     "quant_matmul_2d",
 ]

@@ -1,6 +1,6 @@
 """Fused attention kernels (Pallas/Mosaic).
 
-Two kernels, mirroring the two jnp reference paths in
+Three kernel families, mirroring the jnp reference paths in
 :mod:`llm_consensus_tpu.ops.attention`:
 
 - :func:`flash_causal_attention` — prefill/full attention. Grid over
@@ -11,6 +11,13 @@ Two kernels, mirroring the two jnp reference paths in
 - :func:`flash_decode_attention` — single-token decode against the KV
   cache with per-sequence ``valid_len`` masking (the ragged-decode op of
   BASELINE.json's north star). Grid over (batch, kv-head).
+- :func:`ragged_paged_attention` — ONE program for the whole serving
+  mix: decode rows, prefill-chunk rows, shared-prefix groups, and
+  sliding windows over the page pool (and, via thin wrappers, the
+  dense bf16 / int8 head-major / stacked int8 caches), with per-row
+  metadata riding scalar prefetch. Everything that used to be its own
+  kernel (plain paged decode, the grouped two-phase family) is now a
+  wrapper over this body.
 
 GQA layout: H = Hkv * G query heads share each kv head; programs are
 per-(batch, kv-head) and process all G group heads at once, so K/V are
@@ -549,223 +556,63 @@ def _decode_q8_stacked_kernel(
 
 
 # ---------------------------------------------------------------------------
-# Paged decode attention (vLLM-style page tables, TPU-native)
-# ---------------------------------------------------------------------------
-
-
-def _paged_decode_kernel(
-    tbl_ref,  # [B*P] int32 scalar-prefetch: flattened page table
-    len_ref,  # [B] int32 scalar-prefetch: valid lengths
-    q_ref,  # [1, Hkv, G, D]
-    k_ref,  # [1, pg, Hkv, D] — ONE page of the pool, all kv heads
-    v_ref,
-    o_ref,  # [1, Hkv, G, D]
-    m_ref,  # [Hkv*G, 1] f32 scratch: running max
-    l_ref,  # [Hkv*G, 1] f32 scratch: running denominator
-    acc_ref,  # [Hkv*G, D] f32 scratch: running numerator
-    *,
-    scale: float,
-    window: int,
-):
-    """One (row, page) program — online softmax across pages, all kv
-    heads per program (static unroll; Mosaic requires the pool block's
-    trailing dims to cover the [Hkv, D] axes whole, so a per-head grid
-    axis cannot legally block the native pool layout).
-
-    The page grid dimension is innermost, so TPU's sequential grid
-    execution makes the VMEM scratch a legal accumulator: page j=0
-    initializes, every page folds its per-head [G, pg] score tile in,
-    the last page writes ``acc / l``. Pages beyond the row's valid
-    length contribute exp(-inf)=0 — the NULL page's garbage never
-    reaches the output, mirroring the gather path's masking."""
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-    n_pages = pl.num_programs(1)
-    _, pg, hkv, d = k_ref.shape
-    g = q_ref.shape[2]
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full((hkv * g, 1), _NEG_INF, jnp.float32)
-        l_ref[...] = jnp.zeros((hkv * g, 1), jnp.float32)
-        acc_ref[...] = jnp.zeros((hkv * g, d), jnp.float32)
-
-    valid = len_ref[b]
-    # Pages wholly BEFORE the sliding window contribute exactly nothing
-    # (every slot masked): skip their compute entirely — paired with the
-    # sentinel-page remap in the wrapper's index maps, a long-context
-    # windowed row costs O(window), not O(total length).
-    live = (j + 1) * pg > valid - window if window > 0 else j >= 0
-
-    @pl.when(live)
-    def _fold_page():
-        slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
-        in_range = slot < valid
-        if window > 0:
-            # Sliding window (Mistral): only the last `window` slots
-            # attend — same rule as ops.attention.decode_attention.
-            in_range &= slot >= valid - window
-        for head in range(hkv):  # static unroll over kv heads
-            hs = slice(head * g, (head + 1) * g)
-            q = q_ref[0, head].astype(jnp.float32)  # [G, D]
-            k = k_ref[0, :, head, :]  # [pg, D]
-            scores = jax.lax.dot_general(
-                q,
-                k.astype(jnp.float32),
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [G, pg]
-            scores = jnp.where(in_range, scores, _NEG_INF)
-
-            m_prev = m_ref[hs]
-            m_new = jnp.maximum(
-                m_prev, jnp.max(scores, axis=-1, keepdims=True)
-            )
-            # A fully-masked page (or row) keeps m at -inf;
-            # exp(-inf - -inf) would be NaN — substitute 0 so p stays 0
-            # for masked slots.
-            m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-            p = jnp.exp(scores - m_safe)  # [G, pg]
-            alpha = jnp.where(
-                m_prev <= _NEG_INF / 2, 0.0, jnp.exp(m_prev - m_safe)
-            )
-            l_ref[hs] = l_ref[hs] * alpha + jnp.sum(
-                p, axis=-1, keepdims=True
-            )
-            pv = jax.lax.dot_general(
-                p,
-                v_ref[0, :, head, :].astype(jnp.float32),
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [G, D]
-            acc_ref[hs] = acc_ref[hs] * alpha + pv
-            m_ref[hs] = m_new
-
-    @pl.when(j == n_pages - 1)
-    def _write():
-        denom = jnp.maximum(l_ref[...], 1e-30)
-        out = acc_ref[...] / denom  # [Hkv*G, D]
-        o_ref[0] = out.reshape(hkv, g, d).astype(o_ref.dtype)
-
-
-def paged_decode_attention(
-    q: jnp.ndarray,
-    k_pool: jnp.ndarray,
-    v_pool: jnp.ndarray,
-    page_table: jnp.ndarray,
-    valid_len: jnp.ndarray,
-    window: int = 0,
-    interpret: bool | None = None,
-) -> jnp.ndarray:
-    """Decode attention THROUGH the page table — no pool gather.
-
-    q: [B, H, D]; k_pool/v_pool: [n_pages, page, Hkv, D] (one layer's
-    pool); page_table: [B, P] int32 page ids (NULL page for unused
-    slots); valid_len: [B] tokens readable per row. Returns [B, H, D].
-
-    The jnp reference path (``decode_step_paged``'s
-    ``k_pool[tables]``) materializes every row's full padded sequence
-    out of the pool per layer per step — O(B * P * page) HBM traffic
-    regardless of true lengths. Here each (row, kv-head) program walks
-    the row's OWN pages via the scalar-prefetched table: the BlockSpec
-    index map reads ``page_table`` to choose which pool page lands in
-    VMEM, so only real pages are streamed and the score tile never
-    touches HBM. ``window`` > 0 applies the sliding-window rule (only
-    the last ``window`` slots attend — Mistral configs). SURVEY §7's
-    "ragged/paged decode attention in Pallas" hard part, paged half.
-    """
-    b, h, d = q.shape
-    n_pages, pg, hkv, _ = k_pool.shape
-    p_per = page_table.shape[1]
-    g = h // hkv
-    if interpret is None:
-        interpret = _interpret_default()
-    scale = d**-0.5
-
-    # [B, Hkv, G, D] q blocks; pool stays in its native layout (any
-    # transpose would materialize the whole pool and defeat the point).
-    q4 = q.reshape(b, hkv, g, d)
-    tbl = page_table.reshape(-1).astype(jnp.int32)
-    lens = valid_len.astype(jnp.int32)
-
-    def _page_map(bi, ji, tbl, lens):
-        page = tbl[bi * p_per + ji]
-        if window > 0:
-            # Pages wholly before the window remap to the sentinel page
-            # 0: consecutive skipped grid steps then request the SAME
-            # block, so their DMAs collapse instead of streaming K/V the
-            # kernel would only mask away (the pl.when skip inside).
-            page = jnp.where((ji + 1) * pg > lens[bi] - window, page, 0)
-        return (page, 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # page table, valid lengths
-        grid=(b, p_per),
-        in_specs=[
-            pl.BlockSpec(
-                (1, hkv, g, d), lambda bi, ji, tbl, lens: (bi, 0, 0, 0)
-            ),
-            pl.BlockSpec((1, pg, hkv, d), _page_map),
-            pl.BlockSpec((1, pg, hkv, d), _page_map),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, hkv, g, d), lambda bi, ji, tbl, lens: (bi, 0, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((hkv * g, 1), jnp.float32),
-            pltpu.VMEM((hkv * g, 1), jnp.float32),
-            pltpu.VMEM((hkv * g, d), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, scale=scale, window=window),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(tbl, lens, q4, k_pool, v_pool)
-    return out.reshape(b, h, d)
-
-
-# ---------------------------------------------------------------------------
-# Shared-prefix decode attention (two-phase, flash-decoding LSE merge)
+# Ragged paged attention: ONE program for mixed decode + prefill-chunk rows
 # ---------------------------------------------------------------------------
 #
-# The self-consistency / consensus-panel decode workload is N sequences
-# over ONE shared prompt: the ungrouped kernels above stream the common
-# prefix KV once PER SEQUENCE, so the KV half of the decode roofline
-# scales as N*S instead of S + N*suffix. The kernels below split the
-# attention into
+# The serving hot loop used to run a zoo of per-shape kernels — plain
+# paged decode, grouped shared-prefix decode (two pallas_calls + a host
+# merge), dense/int8 shared-prefix pairs — each with its own
+# engage/fallback matrix entry (sliding window, stacked cache), and
+# chunked prefill as a SEPARATE device program serializing against
+# decode. The kernel below replaces the family with one program in the
+# style of TPU Ragged Paged Attention (PAPERS.md): every row carries
+# per-row (length, suffix-start, group-id) metadata via scalar
+# prefetch, and row KIND is a grid-position case of the same body:
 #
-#   phase 1  all member queries, STACKED, against one copy of the
-#            shared-prefix KV (one HBM read for the whole group; the
-#            per-row GEMV becomes a [N*G, D] x [D, blk] GEMM — MXU
-#            food, not VPU scraps), and
-#   phase 2  each sequence against its own suffix slots only,
+#   programs [0, B)          decode rows — one query token each, pages
+#                            walked through the row's scalar-prefetched
+#                            table, sliding window as extra masking;
+#   program  B (optional)    ONE prefill-chunk row — C query tokens with
+#                            the ragged-causal rule (query i at absolute
+#                            position start+i sees slots <= start+i),
+#                            walked through the chunk's own host table;
+#   programs [B+nc, +Gm)     shared-prefix groups — ALL decode queries
+#                            stacked against one read of the group's
+#                            shared page run (members masked in),
+#                            folding into a separate accumulator.
 #
-# each emitting flash-decoding (m, l, o) partials that merge EXACTLY via
-# ops.attention.merge_decode_partials (log-sum-exp recombination — the
-# split is lossless, not an approximation). Three layout variants:
-# dense bf16 (the engine's N-fanout cache), dense int8 head-major
-# (kv_quant fan-out), and the paged pool (continuous batching, where
-# groups come from the PrefixRegistry's shared page runs). No
-# sliding-window support anywhere in the family: windowed configs fall
-# back to the ungrouped kernels at the call sites.
+# Every class folds pages with the same :func:`_online_fold`; row
+# partials come out per row, the group phase comes out once, and the
+# two merge EXACTLY on the host via flash-decoding log-sum-exp
+# (:func:`~llm_consensus_tpu.ops.attention.merge_decode_partials`) —
+# bit-for-bit the arithmetic of the two-phase kernels this replaces.
+# Pages outside a row's live range (before the suffix start, past the
+# fill, or wholly before the sliding window) are sentinel-remapped to
+# page 0 in the index map, so consecutive dead grid steps request the
+# SAME block and their DMAs collapse.
+#
+# Three static layouts share the body (there is one kernel, not three):
+# the serving pool [n_pages, page, Hkv, D]; the dense int8 head-major
+# cache [B, Hkv, S, D] (+ scales), viewed as identity-tabled pages; and
+# the STACKED int8 cache [L, B, Hkv, S, D] with the layer index riding
+# scalar prefetch into the index maps. The dense bf16 cache needs no
+# layout of its own — [B, S, Hkv, D] reshapes into pool pages for free.
+# The XLA reference (ops.attention.ragged_paged_attention_reference) is
+# the parity oracle and the non-Pallas path.
 
 
 def _sp_block(s: int, cap: int = 128) -> int:
-    """Largest divisor of ``s`` <= cap — the S-axis block width for the
-    two-phase DENSE kernels (blocks let the suffix pass SKIP the prefix
-    region instead of streaming it per row).
+    """Largest divisor of ``s`` <= cap — the S-axis page width the
+    DENSE-cache wrappers use to view a contiguous cache as pool pages.
 
     The cap trades DMA size against skip granularity: the suffix pass
     can only skip whole blocks, so a prefix shorter than one block
-    saves nothing there while phase 1 still pays one extra read of the
-    prefix region — a bounded overhead of < blk slots per row plus one
-    prefix read, flipping to a win as soon as the prefix spans a block
-    (the canonical fan-out prompt buckets are >= 128). 128 keeps the
-    blocks at lane width and makes that break-even point the smallest
-    bucket the engine serves; the paged variant's unit is the page and
-    needs none of this.
+    saves nothing there while the group phase still pays one extra
+    read of the prefix region — a bounded overhead of < blk slots per
+    row plus one prefix read, flipping to a win as soon as the prefix
+    spans a block (the canonical fan-out prompt buckets are >= 128).
+    128 keeps the blocks at lane width; the paged variant's unit is
+    the pool page and needs none of this.
     """
     blk = min(cap, s)
     while s % blk:
@@ -780,9 +627,8 @@ def _online_fold(m_ref, l_ref, acc_ref, idx, scores, v, v_row_scale=None):
     fp32 (already masked to -inf outside the live range); v [blk, D].
     ``v_row_scale`` [1, blk]: per-slot dequant scale folded into the
     VALUE product only (the l denominator stays the true softmax sum) —
-    the same linear-dequant trick as :func:`_q8_attend`. The arithmetic
-    is identical to :func:`_paged_decode_kernel`'s in-kernel fold; it
-    lives here once so every two-phase variant shares it.
+    the same linear-dequant trick as :func:`_q8_attend`. Every program
+    class of the ragged kernel folds through this one function.
     """
     m_prev = m_ref[idx]
     m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
@@ -800,539 +646,604 @@ def _online_fold(m_ref, l_ref, acc_ref, idx, scores, v, v_row_scale=None):
     m_ref[idx] = m_new
 
 
-def _partials_to_rows(m, l, o, b: int, hkv: int, g: int):
-    """Phase-1 partials [Hkv, B*G, *] -> phase-2 row layout [B*Hkv, G, *]."""
+def _ragged_kernel(
+    *refs,
+    scale: float,
+    b: int,
+    hkv: int,
+    g: int,
+    d: int,
+    nc: int,
+    cq: int,
+    gm: int,
+    pg: int,
+    p_per: int,
+    window: int,
+    quant: bool,
+    stacked: bool,
+):
+    """One (program-class row, page) step of the ragged kernel.
 
-    def t(x):
-        return (
-            x.reshape(hkv, b, g, x.shape[-1])
+    ``refs`` is parsed positionally by the same static layout the
+    wrapper builds: scalar prefetch ([layer?], tbl, kvlen, sstart,
+    [rep, gend]), VMEM inputs ([gid, kvlen_v?], q_dec, [q_chunk?],
+    [q_all?], K(+scales), V(+scales)), outputs (decode partials,
+    [chunk partials?], [group partials?]), then scratch. Row scratch is
+    re-initialized at every row's first page; the group accumulator
+    persists across all group programs (they run last) and is written
+    once at the very last program.
+    """
+    i = 0
+    if stacked:
+        i += 1  # layer index: consumed by the index maps only
+    tbl_ref, kvlen_ref, sstart_ref = refs[i : i + 3]
+    i += 3
+    del tbl_ref  # pages are resolved by the index maps
+    if gm:
+        rep_ref, gend_ref = refs[i : i + 2]
+        i += 2
+        del rep_ref
+        gid_ref, kvv_ref = refs[i : i + 2]
+        i += 2
+    q_dec_ref = refs[i]
+    i += 1
+    if nc:
+        q_chunk_ref = refs[i]
+        i += 1
+    if gm:
+        q_all_ref = refs[i]
+        i += 1
+    if quant:
+        kq_ref, ks_ref, vq_ref, vs_ref = refs[i : i + 4]
+        i += 4
+    else:
+        k_ref, v_ref = refs[i : i + 2]
+        i += 2
+    md_ref, ld_ref, od_ref = refs[i : i + 3]
+    i += 3
+    if nc:
+        mc_ref, lc_ref, oc_ref = refs[i : i + 3]
+        i += 3
+    if gm:
+        mg_ref, lg_ref, og_ref = refs[i : i + 3]
+        i += 3
+    m_s, l_s, acc_s = refs[i : i + 3]
+    i += 3
+    if gm:
+        m2_s, l2_s, acc2_s = refs[i : i + 3]
+
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    R = b + nc
+    total = R + gm
+
+    def _k_head(head):
+        """This page's K slab [pg, D] (+ [1, pg] dequant row or None)."""
+        if quant:
+            kq = kq_ref[0, 0, head] if stacked else kq_ref[0, head]
+            ks = (ks_ref[0, 0, head] if stacked else ks_ref[0, head])[None, :]
+            return kq, ks
+        return k_ref[0, :, head, :], None
+
+    def _v_head(head):
+        if quant:
+            vq = vq_ref[0, 0, head] if stacked else vq_ref[0, head]
+            vs = (vs_ref[0, 0, head] if stacked else vs_ref[0, head])[None, :]
+            return vq, vs
+        return v_ref[0, :, head, :], None
+
+    def _fold(idx, q, head, mask, mr, lr, ar):
+        k, ks = _k_head(head)
+        v, vs = _v_head(head)
+        scores = jax.lax.dot_general(
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale if ks is None else ks * scale)
+        scores = jnp.where(mask, scores, _NEG_INF)
+        _online_fold(mr, lr, ar, idx, scores, v, v_row_scale=vs)
+
+    # Row scratch: re-initialized per row (its page walk is contiguous
+    # in the grid), shared by decode and chunk programs.
+    @pl.when(j == 0)
+    def _init_row():
+        rows = m_s.shape[0]
+        m_s[...] = jnp.full((rows, 1), _NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((rows, 1), jnp.float32)
+        acc_s[...] = jnp.zeros((rows, d), jnp.float32)
+
+    @pl.when(s < b)
+    def _decode_row():
+        valid = kvlen_ref[s]
+        lo = sstart_ref[s]
+        if window > 0:
+            # Sliding window: the single query sits at valid - 1 and
+            # sees slots [valid - window, valid) — same rule as
+            # ops.attention.decode_attention.
+            lo = jnp.maximum(lo, valid - window)
+        live = ((j + 1) * pg > lo) & (j * pg < valid)
+
+        @pl.when(live)
+        def _fold_page():
+            slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
+            mask = (slot >= lo) & (slot < valid)
+            for head in range(hkv):  # static unroll over kv heads
+                _fold(
+                    slice(head * g, (head + 1) * g),
+                    q_dec_ref[0, head],
+                    head,
+                    mask,
+                    m_s,
+                    l_s,
+                    acc_s,
+                )
+
+    if nc:
+
+        @pl.when(s == b)
+        def _chunk_row():
+            valid = kvlen_ref[b]  # chunk start + cq
+            qbase = valid - cq
+            lo = sstart_ref[b]
+            lo_all = lo
+            if window > 0:
+                # The union of the cq queries' windows starts at the
+                # FIRST query's window edge.
+                lo_all = jnp.maximum(lo, qbase + 1 - window)
+            live = ((j + 1) * pg > lo_all) & (j * pg < valid)
+
+            @pl.when(live)
+            def _fold_page():
+                slot = j * pg + jax.lax.broadcasted_iota(
+                    jnp.int32, (cq, 1, pg), 2
+                )
+                qpos = qbase + jax.lax.broadcasted_iota(
+                    jnp.int32, (cq, 1, pg), 0
+                )
+                # Ragged causal: chunk query i (absolute position
+                # qbase + i) sees slots <= its own — the cache so far
+                # plus the chunk itself, chunk_decode_attention's rule.
+                mask3 = (slot <= qpos) & (slot >= lo)
+                if window > 0:
+                    mask3 &= slot > qpos - window
+                mask = jnp.broadcast_to(mask3, (cq, g, pg)).reshape(
+                    cq * g, pg
+                )
+                for head in range(hkv):  # static unroll over kv heads
+                    _fold(
+                        slice(head * cq * g, (head + 1) * cq * g),
+                        q_chunk_ref[0, head],
+                        head,
+                        mask,
+                        m_s,
+                        l_s,
+                        acc_s,
+                    )
+
+    if gm:
+        # Group programs run LAST; their accumulator spans all of them.
+        @pl.when((s == R) & (j == 0))
+        def _init_group():
+            m2_s[...] = jnp.full((hkv, b * g, 1), _NEG_INF, jnp.float32)
+            l2_s[...] = jnp.zeros((hkv, b * g, 1), jnp.float32)
+            acc2_s[...] = jnp.zeros((hkv, b * g, d), jnp.float32)
+
+        @pl.when(s >= R)
+        def _group():
+            gi = s - R
+            ge = gend_ref[gi]
+
+            @pl.when(j * pg < ge)
+            def _fold_page():
+                member = gid_ref[...] == gi  # [B, 1]
+                mrow = jnp.broadcast_to(member, (b, g)).reshape(b * g, 1)
+                slot = j * pg + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, pg), 1
+                )
+                mask = mrow & (slot < ge)
+                if window > 0:
+                    # Per-member window edge: members of one group can
+                    # sit at different fills.
+                    wlo = jnp.broadcast_to(
+                        kvv_ref[...] - window, (b, g)
+                    ).reshape(b * g, 1)
+                    mask &= slot >= wlo
+                for head in range(hkv):  # static unroll over kv heads
+                    _fold(
+                        head, q_all_ref[head], head, mask, m2_s, l2_s, acc2_s
+                    )
+
+    # -- writes ---------------------------------------------------------
+
+    @pl.when((s < b) & (j == p_per - 1))
+    def _write_dec():
+        m = m_s[0 : hkv * g]
+        l = l_s[0 : hkv * g]
+        md_ref[0] = m
+        ld_ref[0] = l
+        od_ref[0] = (
+            acc_s[0 : hkv * g] / jnp.maximum(l, 1e-30)
+        ).reshape(hkv, g, d)
+
+    if nc:
+
+        @pl.when((s == b) & (j == p_per - 1))
+        def _write_chunk():
+            l = l_s[...]
+            mc_ref[0] = m_s[...]
+            lc_ref[0] = l
+            oc_ref[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).reshape(
+                hkv, cq * g, d
+            )
+
+    if gm:
+
+        @pl.when((s == total - 1) & (j == p_per - 1))
+        def _write_group():
+            l = l2_s[...]
+            mg_ref[...] = m2_s[...]
+            lg_ref[...] = l
+            og_ref[...] = acc2_s[...] / jnp.maximum(l, 1e-30)
+
+
+def _ragged_attention(
+    q_dec,
+    k_kv,
+    v_kv,
+    page_table,
+    kv_len,
+    suffix_start,
+    *,
+    pg: int,
+    q_chunk=None,
+    gid=None,
+    rep=None,
+    gend=None,
+    window: int = 0,
+    k_scale=None,
+    v_scale=None,
+    layer=None,
+    interpret: bool | None = None,
+):
+    """Assemble and launch ONE ragged program; merge group partials.
+
+    q_dec: [B, H, D]; page_table: [B + nc, P] (row B is the chunk's
+    table when ``q_chunk`` [C, H, D] rides along); kv_len/suffix_start:
+    [B + nc]. K/V layout is static: the pool [n_pages, pg, Hkv, D]
+    (``k_scale`` None), the int8 head-major cache [B, Hkv, S, D] with
+    [B, Hkv, S] scales, or the stacked int8 cache [L, B, Hkv, S, D]
+    (``layer`` a traced index) — the dense layouts are addressed as
+    identity-tabled virtual pages of width ``pg``. Returns out_dec
+    [B, H, D] (and out_chunk [C, H, D] when ``q_chunk``) in q's dtype.
+    """
+    b, h, d = q_dec.shape
+    quant = k_scale is not None
+    stacked = layer is not None
+    if quant:
+        s_len = k_kv.shape[-2]
+        hkv = k_kv.shape[-3]
+        npp = s_len // pg
+        if s_len % pg:
+            raise ValueError(f"cache len {s_len} not a multiple of {pg}")
+    else:
+        hkv = k_kv.shape[2]
+        npp = 0  # unused
+    g = h // hkv
+    nc = 0 if q_chunk is None else 1
+    cq = q_chunk.shape[0] if nc else 1
+    gm = 0 if gid is None else int(rep.shape[0])
+    p_per = page_table.shape[1]
+    R = b + nc
+    total = R + gm
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = d**-0.5
+
+    kvlen = kv_len.astype(jnp.int32)
+    sstart = suffix_start.astype(jnp.int32)
+    pf = []
+    if stacked:
+        pf.append(jnp.atleast_1d(layer).astype(jnp.int32))
+    pf += [page_table.reshape(-1).astype(jnp.int32), kvlen, sstart]
+    if gm:
+        pf += [rep.astype(jnp.int32), gend.astype(jnp.int32)]
+    i_tbl = 1 if stacked else 0
+
+    def _page_of(s, j, pf):
+        """Pool page for program (s, j), dead steps sentinel-remapped
+        to page 0 so their DMAs collapse."""
+        tbl, kvl, sst = pf[i_tbl], pf[i_tbl + 1], pf[i_tbl + 2]
+        row = jnp.where(s < R, s, 0)
+        lo = sst[row]
+        if window > 0:
+            nq = jnp.where(row < b, 1, cq) if nc else 1
+            lo = jnp.maximum(lo, kvl[row] - (nq - 1) - window)
+        live = ((j + 1) * pg > lo) & (j * pg < kvl[row])
+        page = jnp.where(live, tbl[row * p_per + j], 0)
+        if gm:
+            rep_a, gend_a = pf[i_tbl + 3], pf[i_tbl + 4]
+            gi = jnp.clip(s - R, 0, gm - 1)
+            g_page = jnp.where(
+                j * pg < gend_a[gi], tbl[rep_a[gi] * p_per + j], 0
+            )
+            page = jnp.where(s < R, page, g_page)
+        return page
+
+    def _kv_map(s, j, *pf):
+        page = _page_of(s, j, pf)
+        if stacked:
+            return (pf[0][0], page // npp, 0, page % npp, 0)
+        if quant:
+            return (page // npp, 0, page % npp, 0)
+        return (page, 0, 0, 0)
+
+    def _scale_map(s, j, *pf):
+        page = _page_of(s, j, pf)
+        if stacked:
+            return (pf[0][0], page // npp, 0, page % npp)
+        return (page // npp, 0, page % npp)
+
+    inputs = []
+    in_specs = []
+    if gm:
+        inputs.append(gid.astype(jnp.int32).reshape(b, 1))
+        in_specs.append(pl.BlockSpec((b, 1), lambda s, j, *pf: (0, 0)))
+        inputs.append(kvlen[:b].reshape(b, 1))
+        in_specs.append(pl.BlockSpec((b, 1), lambda s, j, *pf: (0, 0)))
+    inputs.append(q_dec.reshape(b, hkv, g, d))
+    in_specs.append(
+        pl.BlockSpec(
+            (1, hkv, g, d),
+            lambda s, j, *pf: (jnp.where(s < b, s, 0), 0, 0, 0),
+        )
+    )
+    if nc:
+        inputs.append(
+            q_chunk.reshape(cq, hkv, g, d)
             .transpose(1, 0, 2, 3)
-            .reshape(b * hkv, g, x.shape[-1])
+            .reshape(1, hkv, cq * g, d)
         )
+        in_specs.append(
+            pl.BlockSpec(
+                (1, hkv, cq * g, d), lambda s, j, *pf: (0, 0, 0, 0)
+            )
+        )
+    if gm:
+        inputs.append(
+            q_dec.reshape(b, hkv, g, d)
+            .transpose(1, 0, 2, 3)
+            .reshape(hkv, b * g, d)
+        )
+        in_specs.append(
+            pl.BlockSpec((hkv, b * g, d), lambda s, j, *pf: (0, 0, 0))
+        )
+    if quant:
+        if stacked:
+            kv_spec = pl.BlockSpec((1, 1, hkv, pg, d), _kv_map)
+            sc_spec = pl.BlockSpec((1, 1, hkv, pg), _scale_map)
+        else:
+            kv_spec = pl.BlockSpec((1, hkv, pg, d), _kv_map)
+            sc_spec = pl.BlockSpec((1, hkv, pg), _scale_map)
+        inputs += [k_kv, k_scale, v_kv, v_scale]
+        in_specs += [kv_spec, sc_spec, kv_spec, sc_spec]
+    else:
+        kv_spec = pl.BlockSpec((1, pg, hkv, d), _kv_map)
+        inputs += [k_kv, v_kv]
+        in_specs += [kv_spec, kv_spec]
 
-    return t(m), t(l), t(o)
+    # Outputs. Row partials are blocked per row with one TRASH block
+    # (index b / index nc) absorbing the write-backs of programs that
+    # own a different class's output — an output block revisited after
+    # its owner moved on would otherwise land stale buffer contents.
+    def _dec_out_map3(s, j, *pf):
+        return (jnp.where(s < b, s, b), 0, 0)
 
+    def _dec_out_map4(s, j, *pf):
+        return (jnp.where(s < b, s, b), 0, 0, 0)
 
-def _merge_rows(m1, l1, o1, m2, l2, o2, b, hkv, g, d, dtype):
-    """LSE-merge two [B*Hkv, G, *] partial sets -> [B, 1, H, D]."""
-    from llm_consensus_tpu.ops.attention import merge_decode_partials
+    out_shapes = [
+        jax.ShapeDtypeStruct((b + 1, hkv * g, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b + 1, hkv * g, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b + 1, hkv, g, d), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, hkv * g, 1), _dec_out_map3),
+        pl.BlockSpec((1, hkv * g, 1), _dec_out_map3),
+        pl.BlockSpec((1, hkv, g, d), _dec_out_map4),
+    ]
+    if nc:
 
-    out = merge_decode_partials(m1, l1, o1, m2, l2, o2)  # [B*Hkv, G, D]
-    return out.reshape(b, 1, hkv * g, d).astype(dtype)
+        def _chunk_out_map3(s, j, *pf):
+            return (jnp.where(s == b, 0, 1), 0, 0)
 
+        def _chunk_out_map4(s, j, *pf):
+            return (jnp.where(s == b, 0, 1), 0, 0, 0)
 
-def _sp_shared_kernel(
-    plen_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o, m_s, l_s, acc_s, *,
-    scale: float, blk: int,
-):
-    """Phase 1, dense bf16: one (kv-head, S-block) program over ROW 0's
-    prefix slab with ALL rows' queries stacked.
-
-    plen_ref: [1] prefix length (scalar prefetch — also drives the
-    block remap that collapses DMAs past the prefix); q_ref:
-    [1, B*G, D]; k_ref/v_ref: [1, blk, D] (row 0's slab, blocked);
-    outputs m/l [Hkv, B*G, 1], o [Hkv, B*G, D] fp32 (written at each
-    head's last block); scratch per (B*G) row.
-    """
-    j = pl.program_id(1)
-    nblk = pl.num_programs(1)
-    plen = plen_ref[0]
-    rows, d = q_ref.shape[1], q_ref.shape[2]
-
-    @pl.when(j == 0)
-    def _init():
-        m_s[...] = jnp.full((rows, 1), _NEG_INF, jnp.float32)
-        l_s[...] = jnp.zeros((rows, 1), jnp.float32)
-        acc_s[...] = jnp.zeros((rows, d), jnp.float32)
-
-    @pl.when(j * blk < plen)
-    def _fold():
-        q = q_ref[0].astype(jnp.float32)  # [B*G, D]
-        scores = jax.lax.dot_general(
-            q,
-            k_ref[0].astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [B*G, blk]
-        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
-        scores = jnp.where(slot < plen, scores, _NEG_INF)
-        _online_fold(m_s, l_s, acc_s, ..., scores, v_ref[0])
-
-    @pl.when(j == nblk - 1)
-    def _write():
-        l = l_s[...]
-        m_o[0] = m_s[...]
-        l_o[0] = l
-        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
-
-
-def _sp_suffix_kernel(
-    plen_ref, len_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o, m_s, l_s, acc_s,
-    *, scale: float, blk: int,
-):
-    """Phase 2, dense bf16: one (row x kv-head, S-block) program over the
-    row's OWN suffix slots [prefix_len, valid). Blocks wholly inside the
-    prefix (or past the fill) are skipped — paired with the wrapper's
-    sentinel remap, the suffix pass costs O(suffix), which is the whole
-    point of the split.
-
-    plen_ref: [1]; len_ref: [B*Hkv] per-row fills; q_ref: [1, G, D];
-    k_ref/v_ref: [1, blk, D]; outputs m/l [B*Hkv, G, 1], o
-    [B*Hkv, G, D] fp32.
-    """
-    r = pl.program_id(0)
-    j = pl.program_id(1)
-    nblk = pl.num_programs(1)
-    plen = plen_ref[0]
-    valid = len_ref[r]
-    g, d = q_ref.shape[1], q_ref.shape[2]
-
-    @pl.when(j == 0)
-    def _init():
-        m_s[...] = jnp.full((g, 1), _NEG_INF, jnp.float32)
-        l_s[...] = jnp.zeros((g, 1), jnp.float32)
-        acc_s[...] = jnp.zeros((g, d), jnp.float32)
-
-    @pl.when(((j + 1) * blk > plen) & (j * blk < valid))
-    def _fold():
-        q = q_ref[0].astype(jnp.float32)  # [G, D]
-        scores = jax.lax.dot_general(
-            q,
-            k_ref[0].astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [G, blk]
-        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
-        scores = jnp.where((slot >= plen) & (slot < valid), scores, _NEG_INF)
-        _online_fold(m_s, l_s, acc_s, ..., scores, v_ref[0])
-
-    @pl.when(j == nblk - 1)
-    def _write():
-        l = l_s[...]
-        m_o[0] = m_s[...]
-        l_o[0] = l
-        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
-
-
-def flash_decode_attention_shared_prefix(
-    q: jnp.ndarray,
-    k_cache: jnp.ndarray,
-    v_cache: jnp.ndarray,
-    valid_len: jnp.ndarray,
-    prefix_len: jnp.ndarray,
-    interpret: bool | None = None,
-) -> jnp.ndarray:
-    """Shared-prefix decode attention, dense bf16 cache (engine fan-out).
-
-    q: [B, 1, H, D]; k_cache/v_cache: [B, max_len, Hkv, D]; valid_len:
-    [B]; prefix_len: traced scalar — every row's slots [0, prefix_len)
-    hold identical K/V (the shared-prefill precondition). Phase 1 reads
-    only ROW 0's copy of that region; phase 2 reads each row's
-    [prefix_len, valid) suffix blocks; merged exactly. Matches
-    :func:`~llm_consensus_tpu.ops.attention.decode_attention_shared_prefix`
-    (and therefore plain decode attention) wherever the precondition
-    holds. No sliding-window support — callers fall back.
-    """
-    b, _, h, d = q.shape
-    s = k_cache.shape[1]
-    hkv = k_cache.shape[2]
-    g = h // hkv
-    if interpret is None:
-        interpret = _interpret_default()
-    scale = d**-0.5
-    blk = _sp_block(s)
-    nblk = s // blk
-
-    kt = k_cache.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
-    vt = v_cache.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
-    q_sh = q.reshape(b, hkv, g, d).transpose(1, 0, 2, 3).reshape(hkv, b * g, d)
-    q_row = q.reshape(b, hkv, g, d).reshape(b * hkv, g, d)
-    plen = jnp.atleast_1d(prefix_len).astype(jnp.int32)
-    lens = jnp.repeat(valid_len.astype(jnp.int32), hkv)
-
-    def _shared_map(hi, j, plen):
-        return (hi, jnp.where(j * blk < plen[0], j, 0), 0)
-
-    m1, l1, o1 = pl.pallas_call(
-        functools.partial(_sp_shared_kernel, scale=scale, blk=blk),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(hkv, nblk),
-            in_specs=[
-                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
-                pl.BlockSpec((1, blk, d), _shared_map),
-                pl.BlockSpec((1, blk, d), _shared_map),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
-                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
-                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((b * g, 1), jnp.float32),
-                pltpu.VMEM((b * g, 1), jnp.float32),
-                pltpu.VMEM((b * g, d), jnp.float32),
-            ],
-        ),
-        out_shape=(
+        out_shapes += [
+            jax.ShapeDtypeStruct((2, hkv * cq * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((2, hkv * cq * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((2, hkv, cq * g, d), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec((1, hkv * cq * g, 1), _chunk_out_map3),
+            pl.BlockSpec((1, hkv * cq * g, 1), _chunk_out_map3),
+            pl.BlockSpec((1, hkv, cq * g, d), _chunk_out_map4),
+        ]
+    if gm:
+        out_shapes += [
             jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
             jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
             jax.ShapeDtypeStruct((hkv, b * g, d), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec((hkv, b * g, 1), lambda s, j, *pf: (0, 0, 0)),
+            pl.BlockSpec((hkv, b * g, 1), lambda s, j, *pf: (0, 0, 0)),
+            pl.BlockSpec((hkv, b * g, d), lambda s, j, *pf: (0, 0, 0)),
+        ]
+
+    qs = cq if nc else 1
+    scratch = [
+        pltpu.VMEM((hkv * qs * g, 1), jnp.float32),
+        pltpu.VMEM((hkv * qs * g, 1), jnp.float32),
+        pltpu.VMEM((hkv * qs * g, d), jnp.float32),
+    ]
+    if gm:
+        scratch += [
+            pltpu.VMEM((hkv, b * g, 1), jnp.float32),
+            pltpu.VMEM((hkv, b * g, 1), jnp.float32),
+            pltpu.VMEM((hkv, b * g, d), jnp.float32),
+        ]
+
+    outs = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel,
+            scale=scale,
+            b=b,
+            hkv=hkv,
+            g=g,
+            d=d,
+            nc=nc,
+            cq=cq,
+            gm=gm,
+            pg=pg,
+            p_per=p_per,
+            window=window,
+            quant=quant,
+            stacked=stacked,
         ),
-        interpret=interpret,
-    )(plen, q_sh, kt[:hkv], vt[:hkv])
-
-    def _suffix_map(r, j, plen, lens):
-        live = ((j + 1) * blk > plen[0]) & (j * blk < lens[r])
-        return (r, jnp.where(live, j, 0), 0)
-
-    m2, l2, o2 = pl.pallas_call(
-        functools.partial(_sp_suffix_kernel, scale=scale, blk=blk),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(b * hkv, nblk),
-            in_specs=[
-                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
-                pl.BlockSpec((1, blk, d), _suffix_map),
-                pl.BlockSpec((1, blk, d), _suffix_map),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
-                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
-                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((g, 1), jnp.float32),
-                pltpu.VMEM((g, 1), jnp.float32),
-                pltpu.VMEM((g, d), jnp.float32),
-            ],
+            num_scalar_prefetch=len(pf),
+            grid=(total, p_per),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
         ),
-        out_shape=(
-            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b * hkv, g, d), jnp.float32),
-        ),
+        out_shape=tuple(out_shapes),
         interpret=interpret,
-    )(plen, lens, q_row, kt, vt)
+    )(*pf, *inputs)
 
-    m1r, l1r, o1r = _partials_to_rows(m1, l1, o1, b, hkv, g)
-    return _merge_rows(m1r, l1r, o1r, m2, l2, o2, b, hkv, g, d, q.dtype)
+    md, ld, od = outs[0][:b], outs[1][:b], outs[2][:b]
+    if gm:
+        from llm_consensus_tpu.ops.attention import merge_decode_partials
 
-
-def _sp_shared_q8_kernel(
-    plen_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, m_o, l_o, o_o,
-    m_s, l_s, acc_s, *, scale: float, blk: int,
-):
-    """Phase 1, int8 head-major: as :func:`_sp_shared_kernel` with the
-    per-slot dequant scales folded into scores/values (`_q8_attend`'s
-    linear-dequant trick). kq_ref/vq_ref: [1, blk, D] int8;
-    ks_ref/vs_ref: [1, 1, blk] f32 — row 0's slabs only."""
-    j = pl.program_id(1)
-    nblk = pl.num_programs(1)
-    plen = plen_ref[0]
-    rows, d = q_ref.shape[1], q_ref.shape[2]
-
-    @pl.when(j == 0)
-    def _init():
-        m_s[...] = jnp.full((rows, 1), _NEG_INF, jnp.float32)
-        l_s[...] = jnp.zeros((rows, 1), jnp.float32)
-        acc_s[...] = jnp.zeros((rows, d), jnp.float32)
-
-    @pl.when(j * blk < plen)
-    def _fold():
-        q = q_ref[0].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q,
-            kq_ref[0].astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * (ks_ref[0] * scale)  # [B*G, blk] * [1, blk]
-        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
-        scores = jnp.where(slot < plen, scores, _NEG_INF)
-        _online_fold(
-            m_s, l_s, acc_s, ..., scores, vq_ref[0], v_row_scale=vs_ref[0]
-        )
-
-    @pl.when(j == nblk - 1)
-    def _write():
-        l = l_s[...]
-        m_o[0] = m_s[...]
-        l_o[0] = l
-        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
+        mg, lg, og = outs[-3], outs[-2], outs[-1]
+        m1r = mg.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
+        l1r = lg.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
+        o1r = og.reshape(hkv, b, g, d).transpose(1, 0, 2, 3)
+        m2r = md.reshape(b, hkv, g, 1)
+        l2r = ld.reshape(b, hkv, g, 1)
+        out_dec = merge_decode_partials(m1r, l1r, o1r, m2r, l2r, od)
+        out_dec = out_dec.reshape(b, h, d).astype(q_dec.dtype)
+    else:
+        out_dec = od.reshape(b, h, d).astype(q_dec.dtype)
+    if not nc:
+        return out_dec
+    oc = outs[5][0]  # [Hkv, cq*G, D]
+    out_chunk = (
+        oc.reshape(hkv, cq, g, d)
+        .transpose(1, 0, 2, 3)
+        .reshape(cq, h, d)
+        .astype(q_dec.dtype)
+    )
+    return out_dec, out_chunk
 
 
-def _sp_suffix_q8_kernel(
-    plen_ref, len_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, m_o, l_o, o_o,
-    m_s, l_s, acc_s, *, scale: float, blk: int,
-):
-    """Phase 2, int8 head-major: as :func:`_sp_suffix_kernel` with
-    dequant scales folded in."""
-    r = pl.program_id(0)
-    j = pl.program_id(1)
-    nblk = pl.num_programs(1)
-    plen = plen_ref[0]
-    valid = len_ref[r]
-    g, d = q_ref.shape[1], q_ref.shape[2]
-
-    @pl.when(j == 0)
-    def _init():
-        m_s[...] = jnp.full((g, 1), _NEG_INF, jnp.float32)
-        l_s[...] = jnp.zeros((g, 1), jnp.float32)
-        acc_s[...] = jnp.zeros((g, d), jnp.float32)
-
-    @pl.when(((j + 1) * blk > plen) & (j * blk < valid))
-    def _fold():
-        q = q_ref[0].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q,
-            kq_ref[0].astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * (ks_ref[0] * scale)
-        slot = j * blk + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
-        scores = jnp.where((slot >= plen) & (slot < valid), scores, _NEG_INF)
-        _online_fold(
-            m_s, l_s, acc_s, ..., scores, vq_ref[0], v_row_scale=vs_ref[0]
-        )
-
-    @pl.when(j == nblk - 1)
-    def _write():
-        l = l_s[...]
-        m_o[0] = m_s[...]
-        l_o[0] = l
-        o_o[0] = acc_s[...] / jnp.maximum(l, 1e-30)
-
-
-def flash_decode_attention_shared_prefix_q8(
+def ragged_paged_attention(
     q: jnp.ndarray,
-    k_q: jnp.ndarray,
-    k_scale: jnp.ndarray,
-    v_q: jnp.ndarray,
-    v_scale: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
     valid_len: jnp.ndarray,
-    prefix_len: jnp.ndarray,
+    *,
+    q_chunk: jnp.ndarray | None = None,
+    chunk_table: jnp.ndarray | None = None,
+    chunk_start=None,
+    groups: tuple | None = None,
+    window: int = 0,
+    interpret: bool | None = None,
+):
+    """Mixed prefill+decode attention over the page pool — ONE program.
+
+    q: [B, H, D] decode-row queries; k_pool/v_pool: [n_pages, page,
+    Hkv, D]; page_table: [B, P]; valid_len: [B] tokens readable per
+    decode row.
+
+    ``q_chunk`` [C, H, D] adds ONE prefill-chunk row: C queries at
+    absolute positions ``chunk_start + i``, walking ``chunk_table``
+    [P] (the chunk's K/V must already be scattered through it), with
+    the ragged-causal rule of
+    :func:`~llm_consensus_tpu.ops.attention.chunk_decode_attention`.
+    ``groups`` = (group_id [B] (-1 ungrouped), group_rep [Gm],
+    group_end [Gm] tokens, shared_start [B]) — decode rows sharing a
+    prefix page run read it ONCE per group (all member queries
+    stacked), each row's own walk starting at ``shared_start``; the
+    partials merge exactly via flash-decoding LSE. ``window`` > 0
+    applies sliding-window masking to every row kind. Returns
+    out_dec [B, H, D] (and out_chunk [C, H, D] when ``q_chunk``).
+    """
+    b = q.shape[0]
+    pg = k_pool.shape[1]
+    kvlen = valid_len.astype(jnp.int32)
+    if groups is not None:
+        gid, rep, gend, sstart = groups
+        sstart = sstart.astype(jnp.int32)
+    else:
+        gid = rep = gend = None
+        sstart = jnp.zeros((b,), jnp.int32)
+    tbl = page_table
+    if q_chunk is not None:
+        cq = q_chunk.shape[0]
+        tbl = jnp.concatenate(
+            [page_table.astype(jnp.int32), chunk_table[None].astype(jnp.int32)]
+        )
+        kvlen = jnp.concatenate(
+            [kvlen, jnp.asarray(chunk_start, jnp.int32).reshape(1) + cq]
+        )
+        sstart = jnp.concatenate([sstart, jnp.zeros((1,), jnp.int32)])
+    return _ragged_attention(
+        q,
+        k_pool,
+        v_pool,
+        tbl,
+        kvlen,
+        sstart,
+        pg=pg,
+        q_chunk=q_chunk,
+        gid=gid,
+        rep=rep,
+        gend=gend,
+        window=window,
+        interpret=interpret,
+    )
+
+
+# -- thin wrappers: the pre-ragged kernel family ----------------------------
+#
+# Everything below is signature-compatible with the kernels it replaced
+# (PR 3's two-phase family and the plain paged row kernel) but runs the
+# ONE ragged kernel body above — same arithmetic, one implementation.
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    window: int = 0,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """Shared-prefix decode attention over the int8 head-major cache.
+    """Decode attention THROUGH the page table — no pool gather.
 
-    q: [B, 1, H, D]; k_q/v_q: [B, Hkv, S, D] int8 (QuantKVCache layout —
-    the per-(row, head) slab reshape is zero-copy); k_scale/v_scale:
-    [B, Hkv, S] f32; valid_len: [B]; prefix_len: traced scalar. Same
-    two-phase split as :func:`flash_decode_attention_shared_prefix`;
-    HBM reads stay int8 + one f32 scale per slot.
+    q: [B, H, D]; k_pool/v_pool: [n_pages, page, Hkv, D]; page_table:
+    [B, P]; valid_len: [B]. The all-decode, ungrouped case of
+    :func:`ragged_paged_attention`.
     """
-    b, _, h, d = q.shape
-    hkv, s = k_q.shape[1], k_q.shape[2]
-    g = h // hkv
-    if interpret is None:
-        interpret = _interpret_default()
-    scale = d**-0.5
-    blk = _sp_block(s)
-    nblk = s // blk
-
-    kq2 = k_q.reshape(b * hkv, s, d)
-    vq2 = v_q.reshape(b * hkv, s, d)
-    ks2 = k_scale.reshape(b * hkv, 1, s)
-    vs2 = v_scale.reshape(b * hkv, 1, s)
-    q_sh = q.reshape(b, hkv, g, d).transpose(1, 0, 2, 3).reshape(hkv, b * g, d)
-    q_row = q.reshape(b * hkv, g, d)
-    plen = jnp.atleast_1d(prefix_len).astype(jnp.int32)
-    lens = jnp.repeat(valid_len.astype(jnp.int32), hkv)
-
-    def _shared_map(hi, j, plen):
-        return (hi, jnp.where(j * blk < plen[0], j, 0), 0)
-
-    def _shared_scale_map(hi, j, plen):
-        return (hi, 0, jnp.where(j * blk < plen[0], j, 0))
-
-    m1, l1, o1 = pl.pallas_call(
-        functools.partial(_sp_shared_q8_kernel, scale=scale, blk=blk),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(hkv, nblk),
-            in_specs=[
-                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
-                pl.BlockSpec((1, blk, d), _shared_map),
-                pl.BlockSpec((1, 1, blk), _shared_scale_map),
-                pl.BlockSpec((1, blk, d), _shared_map),
-                pl.BlockSpec((1, 1, blk), _shared_scale_map),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
-                pl.BlockSpec((1, b * g, 1), lambda hi, j, plen: (hi, 0, 0)),
-                pl.BlockSpec((1, b * g, d), lambda hi, j, plen: (hi, 0, 0)),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((b * g, 1), jnp.float32),
-                pltpu.VMEM((b * g, 1), jnp.float32),
-                pltpu.VMEM((b * g, d), jnp.float32),
-            ],
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((hkv, b * g, d), jnp.float32),
-        ),
-        interpret=interpret,
-    )(plen, q_sh, kq2[:hkv], ks2[:hkv], vq2[:hkv], vs2[:hkv])
-
-    def _suffix_map(r, j, plen, lens):
-        live = ((j + 1) * blk > plen[0]) & (j * blk < lens[r])
-        return (r, jnp.where(live, j, 0), 0)
-
-    def _suffix_scale_map(r, j, plen, lens):
-        live = ((j + 1) * blk > plen[0]) & (j * blk < lens[r])
-        return (r, 0, jnp.where(live, j, 0))
-
-    m2, l2, o2 = pl.pallas_call(
-        functools.partial(_sp_suffix_q8_kernel, scale=scale, blk=blk),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(b * hkv, nblk),
-            in_specs=[
-                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
-                pl.BlockSpec((1, blk, d), _suffix_map),
-                pl.BlockSpec((1, 1, blk), _suffix_scale_map),
-                pl.BlockSpec((1, blk, d), _suffix_map),
-                pl.BlockSpec((1, 1, blk), _suffix_scale_map),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
-                pl.BlockSpec((1, g, 1), lambda r, j, plen, lens: (r, 0, 0)),
-                pl.BlockSpec((1, g, d), lambda r, j, plen, lens: (r, 0, 0)),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((g, 1), jnp.float32),
-                pltpu.VMEM((g, 1), jnp.float32),
-                pltpu.VMEM((g, d), jnp.float32),
-            ],
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b * hkv, g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b * hkv, g, d), jnp.float32),
-        ),
-        interpret=interpret,
-    )(plen, lens, q_row, kq2, ks2, vq2, vs2)
-
-    m1r, l1r, o1r = _partials_to_rows(m1, l1, o1, b, hkv, g)
-    return _merge_rows(m1r, l1r, o1r, m2, l2, o2, b, hkv, g, d, q.dtype)
-
-
-# -- paged variant: groups over the page pool -------------------------------
-
-
-def _paged_shared_kernel(
-    rep_ref, gp_ref, tbl_ref, gid_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o,
-    m_s, l_s, acc_s, *, scale: float,
-):
-    """Phase 1, paged: one (group, shared-page) program — every row's
-    queries STACKED against the group's shared page run (read once per
-    group via the representative row's table), non-members masked out.
-
-    rep_ref/gp_ref: [Gm] representative row / shared-page count per
-    group (scalar prefetch; gp == 0 for padding groups);
-    tbl_ref: [B*P] flattened page table (consumed by the index map);
-    gid_ref: [B, 1] VMEM group id per row (-1 = ungrouped); q_ref:
-    [Hkv, B*G, D]; k_ref/v_ref: [1, pg, Hkv, D] — one pool page.
-    Outputs m/l [Hkv, B*G, 1], o [Hkv, B*G, D] fp32, written once at
-    the very last program. Scratch is per (head, row) and accumulates
-    across ALL groups: each row belongs to at most one group, so its
-    rows of the scratch only ever fold scores from that group's pages.
-    """
-    gi = pl.program_id(0)
-    ji = pl.program_id(1)
-    last = (gi == pl.num_programs(0) - 1) & (ji == pl.num_programs(1) - 1)
-    hkv, rows, d = q_ref.shape
-    bsz = gid_ref.shape[0]
-    g = rows // bsz
-    pg = k_ref.shape[1]
-
-    @pl.when((gi == 0) & (ji == 0))
-    def _init():
-        m_s[...] = jnp.full((hkv, rows, 1), _NEG_INF, jnp.float32)
-        l_s[...] = jnp.zeros((hkv, rows, 1), jnp.float32)
-        acc_s[...] = jnp.zeros((hkv, rows, d), jnp.float32)
-
-    @pl.when(ji < gp_ref[gi])
-    def _fold_page():
-        member = gid_ref[...] == gi  # [B, 1]
-        mrow = jnp.broadcast_to(member, (bsz, g)).reshape(rows, 1)
-        for head in range(hkv):  # static unroll over kv heads
-            q = q_ref[head].astype(jnp.float32)  # [B*G, D]
-            scores = jax.lax.dot_general(
-                q,
-                k_ref[0, :, head, :].astype(jnp.float32),
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [B*G, pg]
-            scores = jnp.where(mrow, scores, _NEG_INF)
-            _online_fold(
-                m_s, l_s, acc_s, head, scores, v_ref[0, :, head, :]
-            )
-
-    @pl.when(last)
-    def _write():
-        l = l_s[...]
-        m_o[...] = m_s[...]
-        l_o[...] = l
-        o_o[...] = acc_s[...] / jnp.maximum(l, 1e-30)
-
-
-def _paged_suffix_kernel(
-    start_ref, tbl_ref, len_ref, q_ref, k_ref, v_ref, m_o, l_o, o_o,
-    m_s, l_s, acc_s, *, scale: float,
-):
-    """Phase 2, paged: the per-row page walk of
-    :func:`_paged_decode_kernel`, restricted to the row's OWN suffix
-    pages (pages wholly inside the shared run are skipped — paired with
-    the wrapper's sentinel remap their DMAs collapse) and emitting
-    (m, l, o) partials instead of the final normalize.
-
-    start_ref: [B] first unshared token per row (0 = whole row, the
-    ungrouped case); len_ref: [B]; q_ref: [1, Hkv, G, D];
-    k_ref/v_ref: [1, pg, Hkv, D]; outputs m/l [B, Hkv*G, 1],
-    o [B, Hkv, G, D].
-    """
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-    n_pages = pl.num_programs(1)
-    _, pg, hkv, d = k_ref.shape
-    g = q_ref.shape[2]
-
-    @pl.when(j == 0)
-    def _init():
-        m_s[...] = jnp.full((hkv * g, 1), _NEG_INF, jnp.float32)
-        l_s[...] = jnp.zeros((hkv * g, 1), jnp.float32)
-        acc_s[...] = jnp.zeros((hkv * g, d), jnp.float32)
-
-    start = start_ref[b]
-    valid = len_ref[b]
-
-    @pl.when(((j + 1) * pg > start) & (j * pg < valid))
-    def _fold_page():
-        slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
-        in_range = (slot >= start) & (slot < valid)
-        for head in range(hkv):  # static unroll over kv heads
-            hs = slice(head * g, (head + 1) * g)
-            q = q_ref[0, head].astype(jnp.float32)  # [G, D]
-            scores = jax.lax.dot_general(
-                q,
-                k_ref[0, :, head, :].astype(jnp.float32),
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [G, pg]
-            scores = jnp.where(in_range, scores, _NEG_INF)
-            _online_fold(
-                m_s, l_s, acc_s, hs, scores, v_ref[0, :, head, :]
-            )
-
-    @pl.when(j == n_pages - 1)
-    def _write():
-        l = l_s[...]
-        m_o[0] = m_s[...]
-        l_o[0] = l
-        o_o[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).reshape(hkv, g, d)
+    return ragged_paged_attention(
+        q, k_pool, v_pool, page_table, valid_len,
+        window=window, interpret=interpret,
+    )
 
 
 def paged_decode_attention_grouped(
@@ -1345,149 +1256,171 @@ def paged_decode_attention_grouped(
     group_rep: jnp.ndarray,
     group_pages: jnp.ndarray,
     shared_start: jnp.ndarray,
+    window: int = 0,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Group-aware paged decode attention (serving hot path).
 
-    q: [B, H, D]; k_pool/v_pool: [n_pages, page, Hkv, D]; page_table:
-    [B, P]; valid_len: [B]. Group metadata (built by
-    :class:`~llm_consensus_tpu.models.paged_cache.GroupTracker` from the
-    PrefixRegistry's shared page runs, all int32):
-
-    - group_id [B]: group per row, -1 for ungrouped rows;
-    - group_rep [Gm]: a member row whose table phase 1 walks;
-    - group_pages [Gm]: pages in the group's shared run (0 = padding);
-    - shared_start [B]: tokens phase 1 covers for the row (page-aligned;
-      0 for ungrouped rows, whose phase 2 then walks the whole row).
-
-    Phase 1 streams each group's shared run ONCE for all members
-    (the ungrouped kernel streams it once per member — the N*S -> S +
-    N*suffix KV-bandwidth reduction this family exists for); phase 2
-    walks per-row suffix pages only; exact LSE merge. Grouped and
-    ungrouped rows coexist: a row with group_id == -1 gets its entire
-    result from phase 2. Output-equal to
-    :func:`paged_decode_attention` (same masking semantics, same
-    arithmetic, reordered reductions). No sliding-window support —
-    callers fall back to the ungrouped kernel for windowed configs.
+    Group metadata as built by
+    :class:`~llm_consensus_tpu.models.paged_cache.GroupTracker`:
+    group_id [B] (-1 ungrouped), group_rep [Gm] (a member row whose
+    table phase 1 walks), group_pages [Gm] (pages in the shared run,
+    0 = padding), shared_start [B] (tokens the shared phase covers,
+    page-aligned). Output-equal to :func:`paged_decode_attention` —
+    the grouped read is a bandwidth optimization, not a semantic one.
+    Sliding windows now ride through (``window``); the old fallback is
+    gone.
     """
-    b, h, d = q.shape
-    n_pages, pg, hkv, _ = k_pool.shape
-    p_per = page_table.shape[1]
-    g = h // hkv
-    gm = group_rep.shape[0]
-    if interpret is None:
-        interpret = _interpret_default()
-    scale = d**-0.5
-
-    tbl = page_table.reshape(-1).astype(jnp.int32)
-    lens = valid_len.astype(jnp.int32)
-    rep = group_rep.astype(jnp.int32)
-    gpages = group_pages.astype(jnp.int32)
-    start = shared_start.astype(jnp.int32)
-    gid_v = group_id.astype(jnp.int32).reshape(b, 1)
-    q_sh = q.reshape(b, hkv, g, d).transpose(1, 0, 2, 3).reshape(hkv, b * g, d)
-    q4 = q.reshape(b, hkv, g, d)
-
-    def _shared_page_map(gi, ji, rep, gpages, tbl):
-        page = tbl[rep[gi] * p_per + ji]
-        return (jnp.where(ji < gpages[gi], page, 0), 0, 0, 0)
-
-    m1, l1, o1 = pl.pallas_call(
-        functools.partial(_paged_shared_kernel, scale=scale),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,  # rep, gpages, tbl
-            grid=(gm, p_per),
-            in_specs=[
-                pl.BlockSpec(
-                    (b, 1), lambda gi, ji, rep, gpages, tbl: (0, 0)
-                ),
-                pl.BlockSpec(
-                    (hkv, b * g, d),
-                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
-                ),
-                pl.BlockSpec((1, pg, hkv, d), _shared_page_map),
-                pl.BlockSpec((1, pg, hkv, d), _shared_page_map),
-            ],
-            out_specs=[
-                pl.BlockSpec(
-                    (hkv, b * g, 1),
-                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (hkv, b * g, 1),
-                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (hkv, b * g, d),
-                    lambda gi, ji, rep, gpages, tbl: (0, 0, 0),
-                ),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((hkv, b * g, 1), jnp.float32),
-                pltpu.VMEM((hkv, b * g, 1), jnp.float32),
-                pltpu.VMEM((hkv, b * g, d), jnp.float32),
-            ],
+    pg = k_pool.shape[1]
+    return ragged_paged_attention(
+        q,
+        k_pool,
+        v_pool,
+        page_table,
+        valid_len,
+        groups=(
+            group_id,
+            group_rep,
+            group_pages.astype(jnp.int32) * pg,
+            shared_start,
         ),
-        out_shape=(
-            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((hkv, b * g, d), jnp.float32),
-        ),
+        window=window,
         interpret=interpret,
-    )(rep, gpages, tbl, gid_v, q_sh, k_pool, v_pool)
+    )
 
-    def _suffix_page_map(bi, ji, start, tbl, lens):
-        live = ((ji + 1) * pg > start[bi]) & (ji * pg < lens[bi])
-        page = tbl[bi * p_per + ji]
-        return (jnp.where(live, page, 0), 0, 0, 0)
 
-    m2, l2, o2 = pl.pallas_call(
-        functools.partial(_paged_suffix_kernel, scale=scale),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,  # start, tbl, lens
-            grid=(b, p_per),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, hkv, g, d),
-                    lambda bi, ji, start, tbl, lens: (bi, 0, 0, 0),
-                ),
-                pl.BlockSpec((1, pg, hkv, d), _suffix_page_map),
-                pl.BlockSpec((1, pg, hkv, d), _suffix_page_map),
-            ],
-            out_specs=[
-                pl.BlockSpec(
-                    (1, hkv * g, 1),
-                    lambda bi, ji, start, tbl, lens: (bi, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, hkv * g, 1),
-                    lambda bi, ji, start, tbl, lens: (bi, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, hkv, g, d),
-                    lambda bi, ji, start, tbl, lens: (bi, 0, 0, 0),
-                ),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((hkv * g, 1), jnp.float32),
-                pltpu.VMEM((hkv * g, 1), jnp.float32),
-                pltpu.VMEM((hkv * g, d), jnp.float32),
-            ],
+def flash_decode_attention_shared_prefix(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+    window: int = 0,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Shared-prefix decode attention, dense bf16 cache (engine fan-out).
+
+    q: [B, 1, H, D]; k_cache/v_cache: [B, max_len, Hkv, D]; valid_len:
+    [B]; prefix_len: traced scalar — every row's slots [0, prefix_len)
+    hold identical K/V. The dense cache reshapes (zero-copy) into pool
+    pages and the whole batch forms one group of the ragged kernel:
+    the prefix region streams once for all rows, each row walks only
+    its own suffix blocks. Matches
+    :func:`~llm_consensus_tpu.ops.attention.decode_attention_shared_prefix`
+    wherever the precondition holds.
+    """
+    b, _, h, d = q.shape
+    s = k_cache.shape[1]
+    hkv = k_cache.shape[2]
+    blk = _sp_block(s)
+    npp = s // blk
+    k_pool = k_cache.reshape(b * npp, blk, hkv, d)
+    v_pool = v_cache.reshape(b * npp, blk, hkv, d)
+    table = jnp.arange(b * npp, dtype=jnp.int32).reshape(b, npp)
+    plen = jnp.asarray(prefix_len, jnp.int32)
+    out = ragged_paged_attention(
+        q[:, 0],
+        k_pool,
+        v_pool,
+        table,
+        valid_len,
+        groups=(
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((1,), jnp.int32),
+            plen.reshape(1),
+            jnp.broadcast_to(plen, (b,)),
         ),
-        out_shape=(
-            jax.ShapeDtypeStruct((b, hkv * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b, hkv * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b, hkv, g, d), jnp.float32),
-        ),
+        window=window,
         interpret=interpret,
-    )(start, tbl, lens, q4, k_pool, v_pool)
+    )
+    return out[:, None]
 
-    from llm_consensus_tpu.ops.attention import merge_decode_partials
 
-    m1r = m1.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
-    l1r = l1.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
-    o1r = o1.reshape(hkv, b, g, d).transpose(1, 0, 2, 3)
-    m2r = m2.reshape(b, hkv, g, 1)
-    l2r = l2.reshape(b, hkv, g, 1)
-    out = merge_decode_partials(m1r, l1r, o1r, m2r, l2r, o2)
-    return out.reshape(b, h, d).astype(q.dtype)
+def flash_decode_attention_shared_prefix_q8(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+    window: int = 0,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Shared-prefix decode attention over the int8 head-major cache.
+
+    q: [B, 1, H, D]; k_q/v_q: [B, Hkv, S, D] int8 (QuantKVCache
+    layout, addressed in place as identity-tabled virtual pages — no
+    transpose, no dequantized materialization); k_scale/v_scale:
+    [B, Hkv, S] f32; valid_len: [B]; prefix_len: traced scalar. Same
+    one-group ragged program as the bf16 wrapper with the dequant
+    scales folded into scores/values in-register.
+    """
+    b, _, h, d = q.shape
+    hkv, s = k_q.shape[1], k_q.shape[2]
+    blk = _sp_block(s)
+    npp = s // blk
+    table = jnp.arange(b * npp, dtype=jnp.int32).reshape(b, npp)
+    plen = jnp.asarray(prefix_len, jnp.int32)
+    out = _ragged_attention(
+        q[:, 0],
+        k_q,
+        v_q,
+        table,
+        valid_len.astype(jnp.int32),
+        jnp.broadcast_to(plen, (b,)),
+        pg=blk,
+        gid=jnp.zeros((b,), jnp.int32),
+        rep=jnp.zeros((1,), jnp.int32),
+        gend=plen.reshape(1),
+        window=window,
+        k_scale=k_scale,
+        v_scale=v_scale,
+        interpret=interpret,
+    )
+    return out[:, None]
+
+
+def flash_decode_attention_shared_prefix_q8_stacked(
+    q: jnp.ndarray,
+    k_q: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_q: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    prefix_len: jnp.ndarray,
+    layer: jnp.ndarray,
+    window: int = 0,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Shared-prefix decode attention reading ONE layer of the stacked
+    int8 cache — the case that used to FALL BACK to the ungrouped
+    stacked kernel. k_q/v_q: [L, B, Hkv, S, D] int8 (the whole stacked
+    buffer); k_scale/v_scale: [L, B, Hkv, S]; ``layer`` a traced index
+    riding scalar prefetch into the index maps, exactly like
+    :func:`flash_decode_attention_q8_stacked`.
+    """
+    b, _, h, d = q.shape
+    hkv, s = k_q.shape[2], k_q.shape[3]
+    blk = _sp_block(s)
+    npp = s // blk
+    table = jnp.arange(b * npp, dtype=jnp.int32).reshape(b, npp)
+    plen = jnp.asarray(prefix_len, jnp.int32)
+    out = _ragged_attention(
+        q[:, 0],
+        k_q,
+        v_q,
+        table,
+        valid_len.astype(jnp.int32),
+        jnp.broadcast_to(plen, (b,)),
+        pg=blk,
+        gid=jnp.zeros((b,), jnp.int32),
+        rep=jnp.zeros((1,), jnp.int32),
+        gend=plen.reshape(1),
+        window=window,
+        k_scale=k_scale,
+        v_scale=v_scale,
+        layer=layer,
+        interpret=interpret,
+    )
+    return out[:, None]

@@ -54,6 +54,8 @@ __all__ = [
     "SCHED_OVERHEAD_SECONDS",
     "PIPELINE_FLUSHES",
     "DISPATCH_INFLIGHT",
+    "DEVICE_PROGRAMS",
+    "RAGGED_ROWS",
     "TRACE_DROPPED",
     "PREFIX_PAGES_SHARED",
     "PREFIX_PAGES_COPIED",
@@ -542,6 +544,26 @@ DISPATCH_INFLIGHT = REGISTRY.gauge(
 PIPELINE_FLUSHES = REGISTRY.counter(
     "gateway_pipeline_flushes_total",
     "Decode-pipeline drains before stable-cache operations",
+)
+#: Fused scheduler step (PR 8): device programs the scheduler loop
+#: dispatched, labeled ``kind="fused"`` (one program carrying the
+#: step's decode rows AND a prefill chunk — the ragged-attention
+#: target state), ``kind="decode"`` (decode rows only) or
+#: ``kind="prefill"`` (a standalone prefill program: a chunk with no
+#: decode batch to ride, or the legacy dense path). Programs per
+#: scheduler iteration == 1 is the fusion working; 2 is the pre-ragged
+#: "one chunk program + one decode program" serialization.
+DEVICE_PROGRAMS = REGISTRY.counter(
+    "gateway_device_programs_total",
+    "Device programs dispatched by the continuous-batcher scheduler loop",
+)
+#: Rows sharing one ragged device program: active decode rows plus the
+#: fused prefill-chunk lane (fused/decode programs only). The mixed
+#: prefill+decode occupancy of the one kernel.
+RAGGED_ROWS = REGISTRY.histogram(
+    "gateway_ragged_rows_per_program",
+    "Rows (decode rows + fused prefill-chunk lanes) per device program",
+    buckets=OCCUPANCY_BUCKETS,
 )
 #: Consensus protocol phase latency, labeled
 #: ``phase="propose"|"evaluate"|"refine"`` — one observation per phase

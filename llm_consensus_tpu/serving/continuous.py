@@ -113,6 +113,7 @@ from llm_consensus_tpu.models.paged_cache import (
 from llm_consensus_tpu.serving.offload import HostPageStore
 from llm_consensus_tpu.models.transformer import (
     decode_step_paged,
+    fused_step_paged,
     prefill,
     prefill_chunk_paged,
     unembed_one,
@@ -164,6 +165,12 @@ from llm_consensus_tpu.server.metrics import (
 )
 from llm_consensus_tpu.server.metrics import (
     DISPATCH_INFLIGHT as _M_DISPATCH_INFLIGHT,
+)
+from llm_consensus_tpu.server.metrics import (
+    DEVICE_PROGRAMS as _M_DEVICE_PROGRAMS,
+)
+from llm_consensus_tpu.server.metrics import (
+    RAGGED_ROWS as _M_RAGGED_ROWS,
 )
 from llm_consensus_tpu.server.metrics import (
     SERVING_ACTIVE as _M_ACTIVE,
@@ -267,6 +274,21 @@ class ContinuousConfig:
     # dispatch->sync->bookkeep loop (the parity baseline); outputs are
     # byte-identical at every depth (tested).
     pipeline_depth: int = 2
+    # Fused scheduler step (PR 8): when a prefill chunk is ready AND
+    # rows are decoding, dispatch ONE device program carrying both —
+    # the chunk rides the decode dispatch as one more row of the
+    # ragged attention kernel, its QKV/MLP matmuls batch with the
+    # decode rows', and its host bookkeeping (readiness flips,
+    # activation, first-token sampling) moves into the pipeline's
+    # fetch path, so chunked prefill stops serializing against decode
+    # and stops forcing a per-chunk device sync. Engages off-mesh with
+    # prefill_chunk > 0 on BOTH kernel paths (the non-Pallas side runs
+    # the same ragged semantics via the XLA reference). False = the
+    # PR 6/7 behavior: one standalone chunk program between decode
+    # steps (the bench's A/B baseline; outputs byte-identical either
+    # way). Read per loop iteration — flipping it between bursts needs
+    # no new batcher.
+    ragged_attention: bool = True
 
 
 @dataclass
@@ -329,6 +351,25 @@ class _Slot:
 
 
 @dataclass
+class _InflightChunk:
+    """A prefill chunk riding an in-flight FUSED program (PR 8).
+
+    The chunk's device work (K/V writes, ragged attention, final-chunk
+    first-token logits) is already ordered on the stream; what waits
+    for the fetch is the HOST bookkeeping — chunk accounting, the
+    final chunk's activation + ``install_seq``. ``slot`` is the
+    identity guard, exactly like ``_Inflight.rows``.
+    """
+
+    idx: int  # slot index
+    slot: _Slot
+    done: bool  # this program wrote the chunk covering the prompt end
+    logits: object  # device [V] last-real-position logits (done only)
+    pos: int  # chunk start position (trace span meta)
+    width: int  # chunk width
+
+
+@dataclass
 class _Inflight:
     """One dispatched, not-yet-fetched decode program (PR 6).
 
@@ -344,6 +385,7 @@ class _Inflight:
     t0: float  # host dispatch stamp (perf_counter)
     k: int  # decode steps folded into this program
     rows: list  # [(slot_idx, _Slot)] decoding at dispatch
+    chunk: _InflightChunk | None = None  # fused prefill chunk (PR 8)
 
 
 class ContinuousBatcher:
@@ -442,17 +484,16 @@ class ContinuousBatcher:
         self._restores: deque = deque()
         self._offload_restored = 0
         # Group-aware decode attention: derive per-step groups from
-        # shared prefix page runs. Engages only where the grouped
-        # Pallas kernel can run (single device, no sliding window, the
-        # paged kernel path itself on) — everywhere else the tracker
-        # stays empty and the plain row kernel runs (the documented
-        # fallback set; README Serving).
+        # shared prefix page runs. The ragged kernel handles groups,
+        # sliding windows, and mixed rows in one program, so the only
+        # remaining engage conditions are the kernel's own (use_pallas,
+        # no mesh) plus the feature knobs — the PR 3 sliding-window
+        # fallback is gone (README Serving).
         self._group_decode = (
             c.prefix_attention
             and c.share_prefix
             and c.prefill_chunk > 0
             and cfg.use_pallas
-            and cfg.sliding_window == 0
             and mesh is None
         )
         self._groups = GroupTracker(c.max_slots, c.page_size)
@@ -475,6 +516,15 @@ class ContinuousBatcher:
         self._inflight: deque[_Inflight] = deque()
         self._tok_dirty = np.zeros((c.max_slots,), bool)
         self._pipeline_flushes = 0
+        # Fused scheduler step (PR 8): device programs by kind plus the
+        # ragged-row occupancy — the same observations behind
+        # gateway_device_programs_total / gateway_ragged_rows_per_program
+        # — and the count of loop iterations that ran any program (the
+        # denominator of "device programs per scheduler iteration").
+        self._programs = {"fused": 0, "decode": 0, "prefill": 0}
+        self._ragged_rows_sum = 0
+        self._ragged_rows_count = 0
+        self._work_iterations = 0
         # perf_counter stamp of the previous fetch's completion: deeper
         # than depth 1 a program starts on device when its predecessor
         # finishes, not at its own dispatch — the step histogram uses
@@ -526,6 +576,7 @@ class ContinuousBatcher:
         )
         self._jit_prefill = {}
         self._jit_chunk = {}  # (chunk, s_bucket) -> compiled chunk prefill
+        self._jit_fused = {}  # (chunk, s_bucket) -> compiled fused step
         self._jit_copy_page = jax.jit(copy_page, donate_argnums=(0,))
         self._jit_install_page = jax.jit(install_page, donate_argnums=(0,))
         self._jit_unembed = jax.jit(partial(unembed_one, self.cfg))
@@ -584,6 +635,19 @@ class ContinuousBatcher:
         a variant are pure data and never recompile).
         """
         k = self._sync_chunk
+        body = self._decode_body(
+            params, seeds, temps, topks, topps, filters_active, groups
+        )
+        (cache, tok_end, _), (toks, logps) = jax.lax.scan(
+            body, (cache, tokens, counts), None, length=k
+        )
+        return toks.T, logps.T, cache, tok_end
+
+    def _decode_body(
+        self, params, seeds, temps, topks, topps, filters_active, groups
+    ):
+        """One decode+sample step as a scan body — shared by the plain
+        and the fused program so the two paths cannot drift."""
 
         def body(carry, _):
             cache, tok, cnt = carry
@@ -603,10 +667,80 @@ class ContinuousBatcher:
             )
             return (cache, next_tok, cnt + 1), (next_tok, logp)
 
-        (cache, tok_end, _), (toks, logps) = jax.lax.scan(
-            body, (cache, tokens, counts), None, length=k
+        return body
+
+    def _fused_sample(
+        self,
+        cfg_chunk,
+        params,
+        cache,
+        tokens,
+        seeds,
+        counts,
+        temps,
+        topks,
+        topps,
+        filters_active,
+        groups,
+        chunk_tokens,
+        chunk_table,
+        chunk_start,
+        chunk_last,
+        chunk_done,
+    ):
+        """The fused scheduler step: ``steps_per_sync`` decode+sample
+        steps AND one prefill chunk as ONE device program (PR 8).
+
+        The chunk rides the FIRST decode step's layer pass
+        (:func:`~llm_consensus_tpu.models.transformer.fused_step_paged`
+        — shared token axis, one K/V scatter, the ragged attention
+        kernel); the remaining k-1 steps run the same scan body as
+        :meth:`_decode_sample`. Returns the plain program's outputs
+        plus ``chunk_logits`` [V] — the unembedded hidden state of the
+        prompt position ``chunk_last`` (the host samples the request's
+        first token from it at fetch, exactly as the standalone path
+        does after its final chunk). ``chunk_done`` is STATIC (the
+        host knows finality at dispatch): non-final chunks skip the
+        full-vocab unembed entirely and return ``None`` — one extra
+        cached trace per (chunk, bucket), no wasted [D]x[D,V] matvec
+        per intermediate chunk.
+        """
+        k = self._sync_chunk
+        logits, hidden, cache = fused_step_paged(
+            self.cfg,
+            params,
+            tokens[:, None],
+            cache,
+            chunk_tokens,
+            chunk_table,
+            chunk_start,
+            groups=groups,
+            cfg_chunk=cfg_chunk,
         )
-        return toks.T, logps.T, cache, tok_end
+        keys = jax.vmap(
+            lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+        )(seeds, counts)
+        tok1, logp1 = sample_token_per_request(
+            logits, keys, temps, topks, topps, filters_active=filters_active
+        )
+        chunk_logits = None
+        if chunk_done:
+            c = chunk_tokens.shape[1]
+            h_last = hidden[
+                0, jnp.clip(chunk_last - chunk_start, 0, c - 1)
+            ]
+            chunk_logits = unembed_one(self.cfg, params, h_last)
+        if k > 1:
+            body = self._decode_body(
+                params, seeds, temps, topks, topps, filters_active, groups
+            )
+            (cache, tok_end, _), (toks, logps) = jax.lax.scan(
+                body, (cache, tok1, counts + 1), None, length=k - 1
+            )
+            toks = jnp.concatenate([tok1[:, None], toks.T], axis=1)
+            logps = jnp.concatenate([logp1[:, None], logps.T], axis=1)
+            return toks, logps, cache, tok_end, chunk_logits
+        return tok1[:, None], logp1[:, None], cache, tok1, chunk_logits
 
     def _prefill_fn(self, s_bucket: int):
         """Jitted per-bucket: prefill one prompt densely, scatter to pages.
@@ -647,6 +781,36 @@ class ContinuousBatcher:
                 partial(prefill_chunk_paged, cfg), donate_argnums=(4,)
             )
         return self._jit_chunk[key]
+
+    def _fused_fn(self, chunk: int, s_bucket: int):
+        """Jitted per (chunk, prompt-bucket): the fused scheduler step
+        (:meth:`_fused_sample`). The bucket pins the chunk side's MoE
+        dispatch path exactly as :meth:`_chunk_fn` does — the fused
+        program must stay output-identical to the split programs it
+        replaces (the A/B contract)."""
+        key = (chunk, s_bucket)
+        if key not in self._jit_fused:
+            cfg_chunk = self.cfg.moe_pin_for(s_bucket, chunk)
+            self._jit_fused[key] = jax.jit(
+                partial(self._fused_sample, cfg_chunk),
+                donate_argnums=(1,),
+                static_argnums=(8, 14),
+            )
+        return self._jit_fused[key]
+
+    @property
+    def _fused_ok(self) -> bool:
+        """Whether a ready chunk may ride the decode dispatch this
+        iteration (PR 8). Off-mesh only — the fused program's concat
+        token axis mixes the data-sharded decode rows with the chunk's
+        tokens, a layout the mesh path doesn't support (open item 1's
+        sharding refactor). Read per iteration: the bench flips
+        ``config.ragged_attention`` between bursts on one batcher."""
+        return (
+            self.config.ragged_attention
+            and self.config.prefill_chunk > 0
+            and self.mesh is None
+        )
 
     # -- public API -----------------------------------------------------
 
@@ -807,6 +971,19 @@ class ContinuousBatcher:
                 # gateway_pipeline_flushes_total (lockstep tested).
                 "dispatch_inflight": len(self._inflight),
                 "pipeline_flushes": self._pipeline_flushes,
+                # Fused scheduler step (PR 8): device programs by kind
+                # (fused = decode rows + a prefill chunk in ONE
+                # program), ragged-row occupancy, and the count of loop
+                # iterations that ran any program — programs/iteration
+                # == 1 is the fusion working; the same observations
+                # behind gateway_device_programs_total /
+                # gateway_ragged_rows_per_program (lockstep tested).
+                "device_programs_fused": self._programs["fused"],
+                "device_programs_decode": self._programs["decode"],
+                "device_programs_prefill": self._programs["prefill"],
+                "ragged_rows_sum": self._ragged_rows_sum,
+                "ragged_rows_count": self._ragged_rows_count,
+                "work_iterations": self._work_iterations,
             }
 
     def close(self) -> None:
@@ -1219,30 +1396,49 @@ class ContinuousBatcher:
             self._offload_restored += 1
         return True
 
-    def _prefill_step(self) -> bool:
-        """Run ONE prefill chunk for one ready prefilling slot.
+    def _count_program(self, kind: str, rows: int | None = None) -> None:
+        """One device program dispatched by the scheduler loop: feed
+        the Prometheus families and the stats() mirrors from the same
+        site (lockstep). ``rows``: ragged-row occupancy for
+        fused/decode programs (decode rows + chunk lanes)."""
+        _M_DEVICE_PROGRAMS.labels(kind=kind).inc()
+        with self._lock:
+            self._programs[kind] += 1
+            if rows is not None:
+                self._ragged_rows_sum += rows
+                self._ragged_rows_count += 1
+        if rows is not None:
+            _M_RAGGED_ROWS.observe(rows)
 
-        The unit of decode stall under chunked prefill: between any two
-        decode steps at most one of these runs, so admission latency
-        costs running requests one bounded chunk, never a whole prompt.
-        Returns True when a chunk was executed.
-        """
-        c = self.config
-        n = c.max_slots
-        idx = None
+    def _pick_prefill_slot(self) -> int | None:
+        """Next ready prefilling slot — deps satisfied and chunks still
+        to run (a slot whose FINAL chunk is already in flight under the
+        fused path waits for its fetch-side activation). Round-robin
+        for fairness; advances the pointer, so callers must run the
+        returned slot's next chunk. None when nothing is ready."""
+        n = self.config.max_slots
         for off in range(n):
             i = (self._prefill_rr + off) % n
             s = self._slots[i]
             if (
                 s is not None
                 and s.phase == "prefill"
+                and s.next_pos < s.prompt_len
                 and all(node.ready for node in s.deps)
             ):
-                idx = i
-                break
-        if idx is None:
-            return False
-        self._prefill_rr = (idx + 1) % n
+                self._prefill_rr = (i + 1) % n
+                return i
+        return None
+
+    def _prefill_step(self, idx: int) -> bool:
+        """Run ONE prefill chunk for slot ``idx`` as a STANDALONE
+        device program (the pre-fusion path, and still the path when no
+        decode batch exists to ride or ``ragged_attention`` is off).
+
+        The unit of decode stall under chunked prefill: between any two
+        decode steps at most one of these runs, so admission latency
+        costs running requests one bounded chunk, never a whole prompt.
+        """
         slot = self._slots[idx]
         if self._inflight:
             # Let in-flight decode work clear the device queue so the
@@ -1251,6 +1447,7 @@ class ContinuousBatcher:
             # and cost ~nothing afterwards.
             jax.block_until_ready(self.cache.length)
         t0 = time.perf_counter()
+        self._count_program("prefill")
         chunk_ids = slot.padded_ids[slot.next_pos : slot.next_pos + slot.chunk]
         hidden, self.cache = self._chunk_fn(slot.chunk, slot.s_bucket)(
             self.params,
@@ -1401,6 +1598,7 @@ class ContinuousBatcher:
         slot = self._slots[idx]
         req = slot.request
         t0 = time.perf_counter()
+        self._count_program("prefill")
         s_bucket = self._bucket(len(req.prompt_ids))
         padded = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
         padded[0, : len(req.prompt_ids)] = req.prompt_ids
@@ -1483,7 +1681,7 @@ class ContinuousBatcher:
                 )
             )
 
-    def _dispatch(self) -> None:
+    def _dispatch(self, chunk_idx: int | None = None) -> None:
         """Enqueue ONE decode program for the current decode batch.
 
         In pipelined mode (``pipeline_depth > 1``) this runs BEFORE the
@@ -1494,6 +1692,15 @@ class ContinuousBatcher:
         bookkeeping for program *n* overlap program *n+1*'s device
         execution. Rows (re)activated since the previous dispatch are
         patched in from the host mirror (``_tok_dirty``).
+
+        ``chunk_idx`` (PR 8): a ready prefilling slot whose next chunk
+        rides THIS program (the fused scheduler step) instead of
+        running standalone. The chunk's device work is ordered on the
+        stream at dispatch — its registry nodes flip ready HERE, since
+        every consumer is a later program on the same stream or a
+        flush-first host operation — while its host bookkeeping
+        (activation, first-token sampling off the returned logits)
+        happens at the fetch, inside the pipeline's overlap window.
         """
         c = self.config
         k = self._sync_chunk
@@ -1509,7 +1716,15 @@ class ContinuousBatcher:
         )
 
         def rows(x):
-            arr = jnp.asarray(x)
+            # SNAPSHOT (np.array copies) before device_put: jax's CPU
+            # runtime zero-copies suitably-aligned numpy buffers, so
+            # handing it the live mutable array lets the post-dispatch
+            # host mutations (the += k counter advance below, fetch-time
+            # _last_tokens updates) race the async program's read —
+            # observed as a dispatched program folding count+k into the
+            # PRNG and re-sampling an already-emitted index. Alignment
+            # made the old code's luck allocation-dependent.
+            arr = jnp.asarray(np.array(x))
             if self._row_sharding is not None:
                 arr = jax.device_put(arr, self._row_sharding)
             return arr
@@ -1537,15 +1752,17 @@ class ContinuousBatcher:
         if self._inflight:
             tokens = self._inflight[-1].next_input
             if self._tok_dirty.any():
+                # Same snapshot rule as rows(): _tok_dirty is reset and
+                # _last_tokens mutated right after this dispatch.
                 tokens = jnp.where(
-                    jnp.asarray(self._tok_dirty),
-                    jnp.asarray(self._last_tokens),
+                    jnp.asarray(np.array(self._tok_dirty)),
+                    jnp.asarray(np.array(self._last_tokens)),
                     tokens,
                 )
         else:
             tokens = rows(self._last_tokens)
         self._tok_dirty[:] = False
-        next_tok, _, self.cache, next_in = self._jit_decode(
+        args = (
             self.params,
             self.cache,
             tokens,
@@ -1557,6 +1774,47 @@ class ContinuousBatcher:
             filters_active,
             groups,
         )
+        chunk_rec = None
+        if chunk_idx is None:
+            next_tok, _, self.cache, next_in = self._jit_decode(*args)
+            self._count_program("decode", rows=len(rows_now))
+        else:
+            slot = self._slots[chunk_idx]
+            chunk_ids = slot.padded_ids[
+                slot.next_pos : slot.next_pos + slot.chunk
+            ]
+            written_end = slot.next_pos + slot.chunk
+            chunk_done = written_end >= slot.prompt_len
+            next_tok, _, self.cache, next_in, chunk_logits = self._fused_fn(
+                slot.chunk, slot.s_bucket
+            )(
+                *args,
+                jnp.asarray(chunk_ids[None]),
+                jnp.asarray(slot.table),
+                jnp.int32(slot.next_pos),
+                jnp.int32(slot.prompt_len - 1),
+                chunk_done,
+            )
+            self._count_program("fused", rows=len(rows_now) + 1)
+            written_real = min(written_end, slot.prompt_len)
+            # Device-stream readiness: the pages this chunk covers are
+            # written by an ALREADY-DISPATCHED program, and every
+            # consumer is either a later program on the same stream
+            # (dependent chunks, decode reads) or a host operation
+            # that flushes the pipeline first (restore installs, CoW
+            # copies, demotion device_gets block on the stream).
+            for node, end_pos in slot.reg_nodes:
+                if not node.ready and end_pos <= written_real:
+                    node.ready = True
+            chunk_rec = _InflightChunk(
+                idx=chunk_idx,
+                slot=slot,
+                done=chunk_done,
+                logits=chunk_logits,
+                pos=slot.next_pos,
+                width=slot.chunk,
+            )
+            slot.next_pos = written_end
         # Host counters track the DEVICE stream at dispatch: the
         # program advances every participating row by k regardless of
         # what the fetch later keeps, so a surviving row's next
@@ -1565,7 +1823,8 @@ class ContinuousBatcher:
             self._counts[i] += k
         self._inflight.append(
             _Inflight(
-                tokens=next_tok, next_input=next_in, t0=t0, k=k, rows=rows_now
+                tokens=next_tok, next_input=next_in, t0=t0, k=k,
+                rows=rows_now, chunk=chunk_rec,
             )
         )
         _M_DISPATCH_INFLIGHT.set(len(self._inflight))
@@ -1653,20 +1912,77 @@ class ContinuousBatcher:
                     break
             if done:
                 self._retire(i)
+        ch = rec.chunk
+        if ch is not None and self._slots[ch.idx] is ch.slot:
+            # Fused prefill chunk (PR 8): host bookkeeping deferred to
+            # the fetch — its device work completed with the program
+            # whose tokens we just pulled. The chunk did not stall the
+            # decode loop (it rode the dispatch), so the stall
+            # histogram observes 0 — count-lockstep with
+            # prefill_chunks, value-honest about the fusion.
+            slot = ch.slot
+            _M_PREFILL_STALL.observe(0.0)
+            with self._lock:
+                self._prefill_chunks += 1
+            trace = slot.request.trace
+            if trace is not None:
+                trace.add_span(
+                    "prefill_chunk", start, dur,
+                    pos=ch.pos, chunk=ch.width, fused=1,
+                )
+            if ch.done:
+                # Final chunk: sample the first token from the logits
+                # the fused program already computed (same PRNG draw,
+                # same unembed as the standalone path), make the row
+                # visible to the decode program, flip to decoding.
+                first = self._sample_first(slot.request, ch.logits)
+                self.cache = install_seq(
+                    self.cache,
+                    jnp.int32(ch.idx),
+                    jnp.asarray(slot.table),
+                    jnp.int32(slot.prompt_len),
+                )
+                self._activate(ch.idx, slot, first)
 
     def _run(self) -> None:
         while not self._stop.is_set():
             self._hb_tick = time.monotonic()
             self._admit()
             progress = False
-            # At most ONE prefill work unit between decode steps —
-            # a host-tier page restore (which unblocks gated prefills)
-            # or a prefill chunk: running slots pay a bounded stall per
-            # admission instead of a whole prompt's prefill.
-            if self.config.prefill_chunk > 0 and (
-                self._restore_step() or self._prefill_step()
-            ):
+            ran_program = False
+            # At most ONE prefill work unit per iteration — a host-tier
+            # page restore (which unblocks gated prefills) or a prefill
+            # chunk: running slots pay a bounded stall per admission
+            # instead of a whole prompt's prefill.
+            chunk_idx = None
+            if self.config.prefill_chunk > 0:
+                if self._restore_step():
+                    progress = True
+                else:
+                    chunk_idx = self._pick_prefill_slot()
+            # The fused scheduler step (PR 8): a ready chunk rides the
+            # decode dispatch as one more ragged-kernel row — ONE
+            # device program per iteration instead of chunk-then-
+            # decode. With no decode batch to ride (or fusion off) the
+            # chunk runs standalone, still one program this iteration.
+            fused = (
+                chunk_idx is not None and self._fused_ok and self._decoding()
+            )
+            if chunk_idx is not None and not fused:
+                self._prefill_step(chunk_idx)
                 progress = True
+                ran_program = True
+                if self._fused_ok:
+                    # A standalone chunk only runs under fusion when
+                    # the decode batch was EMPTY; if its final chunk
+                    # just activated the slot, dispatching in the same
+                    # pass would make this the one iteration that runs
+                    # two programs. Defer to the next pass (the loop
+                    # spins straight back) — one program per iteration
+                    # stays exact, which is the metric the A/B gates.
+                    with self._lock:
+                        self._work_iterations += 1
+                    continue
             if self._decoding():
                 # Software pipeline: enqueue the next program FIRST,
                 # then fetch the oldest once the window is full — the
@@ -1674,10 +1990,11 @@ class ContinuousBatcher:
                 # run. depth 1 reduces to dispatch -> fetch -> bookkeep
                 # (the serialized parity baseline); the while also
                 # drains excess depth after a live depth reduction.
-                self._dispatch()
+                self._dispatch(chunk_idx if fused else None)
                 while len(self._inflight) >= self._depth:
                     self._fetch_one()
                 progress = True
+                ran_program = True
             else:
                 if self._inflight:
                     # The decode batch went empty (every known row
@@ -1689,6 +2006,11 @@ class ContinuousBatcher:
                     # No device step pending: the gap to the next one
                     # is not scheduling overhead.
                     self._last_step_end = None
+            if ran_program:
+                # Denominator of "device programs per scheduler
+                # iteration" — the bench's fusion gate.
+                with self._lock:
+                    self._work_iterations += 1
             if not progress:
                 self._last_step_end = None
                 self._work.wait(timeout=0.1)

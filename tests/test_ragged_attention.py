@@ -1,0 +1,446 @@
+"""One ragged paged attention kernel + the fused scheduler step (PR 8).
+
+Two layers of contract:
+
+- KERNEL: :func:`llm_consensus_tpu.ops.pallas.ragged_paged_attention`
+  serves mixed decode + prefill-chunk rows, shared-prefix groups, and
+  sliding windows in ONE program, parity-checked against the XLA
+  reference (`ops.attention.ragged_paged_attention_reference`) across
+  the ragged shapes: mid-block lengths, MQA, degenerate one-member
+  groups, all-decode, all-prefill, int8 KV (head-major AND stacked).
+- BATCHER: with ``ContinuousConfig.ragged_attention`` (default on) a
+  ready prefill chunk rides the decode dispatch as one more ragged
+  row — ONE device program per scheduler iteration — with generated
+  text byte-identical to the split-program path across pipeline
+  depths, chunk widths, stops landing mid-flight, eviction +
+  host-restore in flight, and sliding-window configs (which used to
+  fall back out of the grouped kernel entirely).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.models.configs import get_config
+from llm_consensus_tpu.models.transformer import init_params
+from llm_consensus_tpu.ops.attention import (
+    decode_attention_shared_prefix_quant,
+    ragged_paged_attention_reference,
+)
+from llm_consensus_tpu.ops.pallas.attention import (
+    flash_decode_attention_shared_prefix_q8_stacked,
+    ragged_paged_attention,
+)
+from llm_consensus_tpu.serving.continuous import (
+    ContinuousBatcher,
+    ContinuousConfig,
+)
+
+CFG = get_config("test-tiny")
+
+_CCFG = dict(
+    max_slots=4,
+    page_size=16,
+    n_pages=96,
+    pages_per_seq=8,
+    max_new_tokens=8,
+    seq_buckets=(16, 32, 64),
+    prefill_chunk=16,
+    share_prefix=True,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Kernel vs XLA reference (CPU interpret)
+# ---------------------------------------------------------------------------
+
+
+def _pool(rng, n_pages=40, pg=8, hkv=2, d=32):
+    k = jnp.asarray(rng.standard_normal((n_pages, pg, hkv, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((n_pages, pg, hkv, d)), jnp.bfloat16)
+    return k, v
+
+
+def _check(got, want, rtol=2e-2, atol=2e-2):
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=rtol, atol=atol,
+    )
+
+
+@pytest.mark.parametrize("window", [0, 9])
+def test_ragged_mixed_rows_match_reference(window):
+    """Decode rows at mid-block lengths + one chunk row, one program."""
+    rng = np.random.default_rng(0)
+    pg, hkv, d, g, b, p_per, cq = 8, 2, 32, 3, 4, 6, 16
+    h = hkv * g
+    kp, vp = _pool(rng, pg=pg, hkv=hkv, d=d)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
+    qc = jnp.asarray(rng.standard_normal((cq, h, d)), jnp.bfloat16)
+    perm = rng.permutation(np.arange(1, 40))
+    tbl = jnp.asarray(perm[: b * p_per].reshape(b, p_per), jnp.int32)
+    ctbl = jnp.asarray(perm[b * p_per : b * p_per + p_per], jnp.int32)
+    vl = jnp.asarray([13, 1, 40, 23], jnp.int32)  # mid-block fills
+    cstart = jnp.int32(11)  # chunk starts mid-block too
+    got_d, got_c = ragged_paged_attention(
+        q, kp, vp, tbl, vl, q_chunk=qc, chunk_table=ctbl,
+        chunk_start=cstart, window=window, interpret=True,
+    )
+    ref_d, ref_c = ragged_paged_attention_reference(
+        q, kp, vp, tbl, vl, q_chunk=qc, chunk_table=ctbl,
+        chunk_start=cstart, window=window,
+    )
+    _check(got_d, ref_d)
+    _check(got_c, ref_c)
+
+
+def test_ragged_mqa_single_kv_head():
+    rng = np.random.default_rng(1)
+    pg, hkv, d, g, b, p_per = 8, 1, 32, 4, 3, 4
+    kp, vp = _pool(rng, pg=pg, hkv=hkv, d=d)
+    q = jnp.asarray(rng.standard_normal((b, hkv * g, d)), jnp.bfloat16)
+    tbl = jnp.asarray(
+        rng.permutation(np.arange(1, 40))[: b * p_per].reshape(b, p_per),
+        jnp.int32,
+    )
+    vl = jnp.asarray([7, 30, 12], jnp.int32)
+    got = ragged_paged_attention(q, kp, vp, tbl, vl, interpret=True)
+    ref = ragged_paged_attention_reference(q, kp, vp, tbl, vl)
+    _check(got, ref)
+
+
+@pytest.mark.parametrize("window", [0, 9])
+def test_ragged_grouped_rows_with_chunk(window):
+    """Groups + ungrouped rows + a chunk lane in the same program —
+    grouping is a bandwidth optimization, output must equal the
+    ungrouped reference (including under a sliding window, the config
+    that used to fall back)."""
+    rng = np.random.default_rng(2)
+    pg, hkv, d, g, b, p_per, cq = 8, 2, 32, 3, 4, 6, 16
+    h = hkv * g
+    kp, vp = _pool(rng, pg=pg, hkv=hkv, d=d)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
+    qc = jnp.asarray(rng.standard_normal((cq, h, d)), jnp.bfloat16)
+    perm = rng.permutation(np.arange(1, 40))
+    tbl = np.asarray(perm[: b * p_per].reshape(b, p_per), np.int32)
+    # Rows 0, 2, 3 share their first page (same tokens by construction).
+    tbl[2, 0] = tbl[0, 0]
+    tbl[3, 0] = tbl[0, 0]
+    tbl = jnp.asarray(tbl)
+    ctbl = jnp.asarray(perm[b * p_per : b * p_per + p_per], jnp.int32)
+    vl = jnp.asarray([13, 9, 40, 23], jnp.int32)
+    groups = (
+        jnp.asarray([0, -1, 0, 0], jnp.int32),  # group_id
+        jnp.asarray([0], jnp.int32),  # rep
+        jnp.asarray([pg], jnp.int32),  # group_end (tokens)
+        jnp.asarray([pg, 0, pg, pg], jnp.int32),  # shared_start
+    )
+    got_d, got_c = ragged_paged_attention(
+        q, kp, vp, tbl, vl, q_chunk=qc, chunk_table=ctbl,
+        chunk_start=jnp.int32(11), groups=groups, window=window,
+        interpret=True,
+    )
+    ref_d, ref_c = ragged_paged_attention_reference(
+        q, kp, vp, tbl, vl, q_chunk=qc, chunk_table=ctbl,
+        chunk_start=jnp.int32(11), window=window,
+    )
+    _check(got_d, ref_d)
+    _check(got_c, ref_c)
+
+
+def test_ragged_degenerate_single_member_group():
+    """A one-member group must not change that row's output (the
+    tracker never emits one, but the kernel tolerates it)."""
+    rng = np.random.default_rng(3)
+    pg, hkv, d, g, b, p_per = 8, 2, 32, 2, 3, 4
+    kp, vp = _pool(rng, pg=pg, hkv=hkv, d=d)
+    q = jnp.asarray(rng.standard_normal((b, hkv * g, d)), jnp.bfloat16)
+    tbl = jnp.asarray(
+        rng.permutation(np.arange(1, 40))[: b * p_per].reshape(b, p_per),
+        jnp.int32,
+    )
+    vl = jnp.asarray([20, 11, 30], jnp.int32)
+    groups = (
+        jnp.asarray([-1, 0, -1], jnp.int32),
+        jnp.asarray([1], jnp.int32),
+        jnp.asarray([pg], jnp.int32),
+        jnp.asarray([0, pg, 0], jnp.int32),
+    )
+    got = ragged_paged_attention(
+        q, kp, vp, tbl, vl, groups=groups, interpret=True
+    )
+    ref = ragged_paged_attention_reference(q, kp, vp, tbl, vl)
+    _check(got, ref)
+
+
+def test_ragged_all_prefill_and_dead_decode_rows():
+    """kv_len 0 decode rows (an idle batcher's slots) stay finite while
+    the chunk row — the only live work — still matches the reference."""
+    rng = np.random.default_rng(4)
+    pg, hkv, d, g, b, p_per, cq = 8, 2, 32, 2, 3, 4, 8
+    h = hkv * g
+    kp, vp = _pool(rng, pg=pg, hkv=hkv, d=d)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
+    qc = jnp.asarray(rng.standard_normal((cq, h, d)), jnp.bfloat16)
+    perm = rng.permutation(np.arange(1, 40))
+    tbl = jnp.zeros((b, p_per), jnp.int32)  # all-NULL tables
+    ctbl = jnp.asarray(perm[:p_per], jnp.int32)
+    vl = jnp.zeros((b,), jnp.int32)
+    got_d, got_c = ragged_paged_attention(
+        q, kp, vp, tbl, vl, q_chunk=qc, chunk_table=ctbl,
+        chunk_start=jnp.int32(0), interpret=True,
+    )
+    _, ref_c = ragged_paged_attention_reference(
+        q, kp, vp, tbl, vl, q_chunk=qc, chunk_table=ctbl,
+        chunk_start=jnp.int32(0),
+    )
+    _check(got_c, ref_c)
+    assert np.isfinite(np.asarray(got_d, np.float32)).all()
+
+
+def test_ragged_stacked_q8_shared_prefix_matches_reference():
+    """The stacked int8 cache case that used to FALL BACK to the
+    ungrouped stacked kernel: shared-prefix attention through the
+    ragged kernel's stacked layout, vs the dequantizing reference."""
+    rng = np.random.default_rng(5)
+    L, b, hkv, s_len, d, g = 3, 4, 2, 64, 32, 3
+    h = hkv * g
+    kq = jnp.asarray(rng.integers(-127, 127, (L, b, hkv, s_len, d)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 127, (L, b, hkv, s_len, d)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.02, (L, b, hkv, s_len)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.02, (L, b, hkv, s_len)), jnp.float32)
+    plen = 21  # mid-block boundary
+    kq = kq.at[:, :, :, :plen].set(
+        jnp.broadcast_to(kq[:, :1, :, :plen], (L, b, hkv, plen, d))
+    )
+    vq = vq.at[:, :, :, :plen].set(
+        jnp.broadcast_to(vq[:, :1, :, :plen], (L, b, hkv, plen, d))
+    )
+    ks = ks.at[:, :, :, :plen].set(
+        jnp.broadcast_to(ks[:, :1, :, :plen], (L, b, hkv, plen))
+    )
+    vs = vs.at[:, :, :, :plen].set(
+        jnp.broadcast_to(vs[:, :1, :, :plen], (L, b, hkv, plen))
+    )
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.bfloat16)
+    vl = jnp.asarray([30, 25, 64, 40], jnp.int32)
+    layer = jnp.int32(1)
+    got = flash_decode_attention_shared_prefix_q8_stacked(
+        q, kq, ks, vq, vs, vl, jnp.int32(plen), layer, interpret=True
+    )
+    ref = decode_attention_shared_prefix_quant(
+        q, kq[1], ks[1], vq[1], vs[1], vl, jnp.int32(plen)
+    )
+    _check(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# Batcher: the fused scheduler step
+# ---------------------------------------------------------------------------
+
+_HEADER = "Panel shared header for every persona, forty ch: "
+
+
+def _serve(batcher, prompts, **kw):
+    futs = [batcher.submit(p, **kw) for p in prompts]
+    return [f.result(timeout=120) for f in futs]
+
+
+def _quiesce(batcher, timeout=10.0):
+    """Wait for the scheduler loop to go fully idle: futures resolve
+    at fetch time, but the loop can still be draining in-flight
+    programs/overshoot — counter reads across that tail would smear
+    iterations between measurement windows."""
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        s = batcher.stats()
+        if (
+            s["active_slots"] == 0
+            and s["prefilling_slots"] == 0
+            and s["dispatch_inflight"] == 0
+            and s["waiting"] == 0
+        ):
+            return s
+        time.sleep(0.01)
+    return batcher.stats()
+
+
+def _burst_texts(params, ragged, depth=2, chunk=16, cfg=CFG, cfgkw=None,
+                 prompts=None, **submit_kw):
+    ccfg = dict(_CCFG, prefill_chunk=chunk)
+    ccfg.update(cfgkw or {})
+    b = ContinuousBatcher(
+        cfg,
+        params,
+        config=ContinuousConfig(
+            **ccfg, pipeline_depth=depth, ragged_attention=ragged
+        ),
+    )
+    prompts = prompts or [
+        _HEADER + "alpha tail one",
+        _HEADER + "beta tail two",
+        "unrelated prompt entirely",
+        _HEADER + "gamma tail three",
+    ]
+    try:
+        return [r.text for r in _serve(b, prompts, **submit_kw)], b.stats()
+    finally:
+        b.close()
+
+
+def test_fused_text_parity_across_depths_and_chunks(params):
+    """THE acceptance contract: generated text is byte-identical with
+    the fused scheduler step on vs off, across pipeline depths {1, 2}
+    and prefill-chunk widths — the fused program is a pure
+    restructuring of the same math."""
+    want, _ = _burst_texts(params, ragged=False, depth=1, chunk=16)
+    for depth in (1, 2):
+        for chunk in (16, 32):
+            for ragged in (True, False):
+                got, _ = _burst_texts(
+                    params, ragged=ragged, depth=depth, chunk=chunk
+                )
+                assert got == want, (ragged, depth, chunk)
+
+
+def test_fused_stop_mid_chunk_parity(params):
+    """A multi-token string stop landing while a later request's chunk
+    rides the pipeline: stop-trim and retirement must stay
+    byte-identical to the split-program path."""
+    prompts = [_HEADER + "one", _HEADER + "two", _HEADER + "three"]
+    kw = dict(prompts=prompts, temperature=0.9, seed=3, stop=["\x00", "ab"])
+    want, _ = _burst_texts(params, ragged=False, depth=1, **kw)
+    for ragged, depth in ((True, 1), (True, 2), (False, 2)):
+        got, _ = _burst_texts(params, ragged=ragged, depth=depth, **kw)
+        assert got == want, (ragged, depth)
+
+
+def test_fused_sliding_window_config_parity(params):
+    """Sliding-window configs used to fall back out of the grouped
+    kernel AND the fused path did not exist; now both ride the same
+    ragged program — text parity on a windowed model config."""
+    wcfg = CFG.with_(sliding_window=24)
+    want, _ = _burst_texts(params, ragged=False, depth=1, cfg=wcfg)
+    for ragged, depth in ((True, 1), (True, 2)):
+        got, _ = _burst_texts(params, ragged=ragged, depth=depth, cfg=wcfg)
+        assert got == want, (ragged, depth)
+
+
+def test_fused_eviction_and_host_restore_in_flight(params):
+    """Host-tier demote/restore (flush-first stable-cache operations)
+    interleaved with fused dispatches: text parity holds and the tier
+    still engages. Pool sized so the second round's header must come
+    back from the host store."""
+    cfgkw = dict(
+        max_slots=2,
+        page_size=16,
+        n_pages=13,  # 12 usable vs a 2x6-page unshared working set
+        pages_per_seq=8,
+        max_new_tokens=6,
+        seq_buckets=(16, 32, 64),
+        prefill_chunk=16,
+        share_prefix=True,
+        host_cache_bytes=8 << 20,
+    )
+    rounds = [
+        [_HEADER + f"p{i} proposes" for i in range(2)],
+        [
+            f"{i} unique filler storm with plenty of padding text {i}"
+            for i in range(4)
+        ],
+        [_HEADER + f"r{i} re-votes" for i in range(2)],
+    ]
+
+    def run(ragged):
+        b = ContinuousBatcher(
+            CFG,
+            params,
+            config=ContinuousConfig(**cfgkw, ragged_attention=ragged),
+        )
+        try:
+            texts = []
+            for r in rounds:
+                texts.append([x.text for x in _serve(b, r)])
+            return texts, b.stats()
+        finally:
+            b.close()
+
+    want, st_off = run(False)
+    got, st_on = run(True)
+    assert got == want
+    assert st_on["offload_restored_pages"] >= 1
+    assert st_on["offload_restored_pages"] == st_off["offload_restored_pages"]
+
+
+def test_device_programs_one_per_iteration_and_metrics_lockstep(params):
+    """Fused leg: every scheduler iteration that ran device work ran
+    exactly ONE program; unfused leg: chunk+decode iterations ran two.
+    The Prometheus families move by the batcher's own deltas."""
+    from llm_consensus_tpu.server.metrics import DEVICE_PROGRAMS, RAGGED_ROWS
+
+    prompts = [_HEADER + f"req {i}" for i in range(6)] + [
+        f"unique header {i} " * 4 for i in range(6)
+    ]
+
+    def run(ragged):
+        before = {
+            k: DEVICE_PROGRAMS.labels(kind=k).value
+            for k in ("fused", "decode", "prefill")
+        }
+        rows0 = (RAGGED_ROWS.sum, RAGGED_ROWS.count)
+        b = ContinuousBatcher(
+            CFG,
+            params,
+            config=ContinuousConfig(**_CCFG, ragged_attention=ragged),
+        )
+        try:
+            texts = [r.text for r in _serve(b, prompts)]
+            st = _quiesce(b)
+        finally:
+            b.close()
+        for k in ("fused", "decode", "prefill"):
+            assert (
+                DEVICE_PROGRAMS.labels(kind=k).value - before[k]
+                == st[f"device_programs_{k}"]
+            )
+        assert RAGGED_ROWS.sum - rows0[0] == st["ragged_rows_sum"]
+        assert RAGGED_ROWS.count - rows0[1] == st["ragged_rows_count"]
+        return texts, st
+
+    texts_on, st_on = run(True)
+    texts_off, st_off = run(False)
+    assert texts_on == texts_off
+    on_programs = sum(
+        st_on[f"device_programs_{k}"] for k in ("fused", "decode", "prefill")
+    )
+    off_programs = sum(
+        st_off[f"device_programs_{k}"] for k in ("fused", "decode", "prefill")
+    )
+    # Fusion engaged and collapsed chunk+decode iterations to ONE
+    # program; the old path needed more programs than iterations.
+    assert st_on["device_programs_fused"] >= 1
+    assert on_programs == st_on["work_iterations"]
+    assert off_programs > st_off["work_iterations"]
+    assert st_off["device_programs_fused"] == 0
+    # Ragged-row occupancy counts decode rows + the fused chunk lane.
+    assert st_on["ragged_rows_count"] >= st_on["device_programs_fused"]
+
+
+def test_prefill_chunks_and_stall_lockstep_under_fusion(params):
+    """Fused chunks observe a 0 stall (they ride the dispatch) but the
+    histogram count stays in lockstep with ``prefill_chunks`` — the
+    PR 2 contract survives the fusion."""
+    from llm_consensus_tpu.server.metrics import PREFILL_STALL_SECONDS
+
+    before = PREFILL_STALL_SECONDS.count
+    _, st = _burst_texts(params, ragged=True)
+    assert st["prefill_chunks"] >= 2
+    assert PREFILL_STALL_SECONDS.count - before == st["prefill_chunks"]
