@@ -321,6 +321,53 @@ def main() -> int:
         "--serve-ragged-attention",
     )
     p.add_argument(
+        "--serve-speculative",
+        action="store_true",
+        help="speculative-decoding A/B leg (PR 9): the same greedy "
+        "panel burst (shared header, identical question — the "
+        "consensus propose round) through ONE batcher flipping "
+        "ContinuousConfig.spec_decode between bursts — spec ON "
+        "dispatches one draft/verify/accept program per round (one "
+        "shared draft stream per agreeing panel group), OFF is plain "
+        "one-token decode — byte-identical text REQUIRED per pair, "
+        "gates on verified tokens per spec device program > 1.0 "
+        "(speculation beating the one-token-per-program roofline) and "
+        "on the panel's shared streams drafting fewer tokens per "
+        "generated token than a unique-prompt control burst; reports "
+        "acceptance rate and tok/s per leg",
+    )
+    p.add_argument(
+        "--serve-draft",
+        default="self",
+        help="--serve-speculative draft: 'self' (target as its own "
+        "draft — the acceptance~1 ceiling, the CPU smoke default) or "
+        "a preset name (e.g. arith-3m; random weights unless "
+        "--serve-draft-ckpt, so treat preset-without-checkpoint as "
+        "the pessimistic floor)",
+    )
+    p.add_argument(
+        "--serve-draft-ckpt",
+        default="",
+        help="orbax checkpoint dir for --serve-draft's weights (the "
+        "trained arith-14m + arith-3m pair from PERF.md r5 is the "
+        "intended chip pairing, via --model arith-14m "
+        "--serve-target-ckpt)",
+    )
+    p.add_argument(
+        "--serve-target-ckpt",
+        default="",
+        help="orbax checkpoint dir for the TARGET model's weights on "
+        "the --serve-speculative leg (acceptance is meaningless "
+        "between random-weight models; both ckpt flags together run "
+        "the trained pair)",
+    )
+    p.add_argument(
+        "--spec-ab-rounds",
+        type=int,
+        default=2,
+        help="alternating off/on paired rounds for --serve-speculative",
+    )
+    p.add_argument(
         "--serve-trace-overhead",
         action="store_true",
         help="observability A/B leg: the identical panel-shaped burst "
@@ -482,6 +529,8 @@ def main() -> int:
     temps = jnp.full((b,), 0.7, jnp.float32)
     key = jax.random.PRNGKey(salt)
 
+    if args.serve_speculative:
+        return _bench_serving_spec_ab(args, cfg, params)
     if args.draft:
         return _bench_speculative(args, cfg, params, tokens, lengths)
     if args.serve_decode_pipeline:
@@ -586,6 +635,31 @@ def main() -> int:
         args.out,
     )
     return 0
+
+
+def _quiesce_batcher(batcher, timeout: float = 10.0) -> None:
+    """Wait until a batcher's scheduler loop is fully idle — the
+    previous burst's futures resolve at fetch time, but the loop can
+    still be draining in-flight programs and overshoot steps; reading
+    per-leg counters across that tail would smear a few iterations
+    into the wrong leg, making any counter gate meaningless. ONE
+    definition for every A/B leg that flips host-loop policy between
+    bursts (ragged, speculative)."""
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout:
+        s = batcher.stats()
+        if (
+            s["active_slots"] == 0
+            and s["prefilling_slots"] == 0
+            and s["dispatch_inflight"] == 0
+            and s["waiting"] == 0
+        ):
+            return
+        time.sleep(0.01)
+    raise RuntimeError(
+        f"batcher did not quiesce within {timeout}s "
+        f"(stats: {batcher.stats()})"
+    )
 
 
 def _serve_pages_per_seq(largest_bucket: int, new_tokens: int,
@@ -1226,34 +1300,10 @@ def _bench_serving_ragged_ab(args, cfg, params) -> int:
                 )
         return out
 
-    def quiesce(batcher, timeout=10.0):
-        """Wait until the scheduler loop is fully idle — the previous
-        burst's futures resolve at fetch time, but the loop can still
-        be draining in-flight programs and overshoot steps; reading
-        the program/iteration counters across that tail would smear a
-        few iterations into the wrong leg."""
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < timeout:
-            s = batcher.stats()
-            if (
-                s["active_slots"] == 0
-                and s["prefilling_slots"] == 0
-                and s["dispatch_inflight"] == 0
-                and s["waiting"] == 0
-            ):
-                return
-            time.sleep(0.01)
-        # A leg boundary read over a still-draining batcher smears
-        # counters between legs — the gate would be meaningless.
-        raise RuntimeError(
-            f"batcher did not quiesce within {timeout}s "
-            f"(stats: {batcher.stats()})"
-        )
-
     def leg(batcher, ragged, prompts):
         """One burst; returns (texts, tok/s, programs-per-iteration)."""
         batcher.config.ragged_attention = ragged
-        quiesce(batcher)
+        _quiesce_batcher(batcher)
         s0 = batcher.stats()
         t0 = time.perf_counter()
         futs = [
@@ -1262,7 +1312,7 @@ def _bench_serving_ragged_ab(args, cfg, params) -> int:
         ]
         results = [f.result(timeout=600) for f in futs]
         wall = time.perf_counter() - t0
-        quiesce(batcher)
+        _quiesce_batcher(batcher)
         s1 = batcher.stats()
         programs = sum(
             s1[k] - s0[k]
@@ -1402,6 +1452,250 @@ def _bench_serving_ragged_ab(args, cfg, params) -> int:
             "[bench] unfused leg never hit a chunk+decode iteration "
             f"(programs/iteration {ratio_off:.3f}) — the burst did not "
             "exercise the fusion; resize the leg",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def _bench_serving_spec_ab(args, cfg, params) -> int:
+    """Speculative decoding inside the batcher A/B (PR 9).
+
+    The burst is the consensus propose round's shape: N greedy
+    requests over ONE shared header with an identical question —
+    prefix KV dedups at admission (PR 2), decode attention groups
+    (PR 3), and under speculation the whole panel rides ONE draft
+    stream (mates' committed texts agree, so each round drafts once
+    and every mate verifies the donor's proposals). ``spec_decode`` is
+    host-loop policy read per iteration, flipped between bursts on
+    the idle batcher (the pipeline/ragged-AB pattern; a flip drains
+    the dispatch pipeline, so plain and spec programs never share a
+    window).
+
+    Gates: per-pair byte-identical greedy text (REQUIRED — greedy
+    accept emits the target argmax chain for ANY draft), verified
+    tokens per spec device program > 1.0 on the spec leg (counted via
+    gateway_device_programs_total{kind=spec} and the generated-token
+    delta: > 1.0 is speculation beating the one-token-per-program
+    roofline; the draft must actually agree with the target — run
+    'self' or a TRAINED pair, a random-weight preset is the
+    pessimistic floor and will fail this gate), and the panel's
+    shared streams drafting FEWER tokens per generated token than a
+    unique-prompt control burst (the amortization realized). tok/s
+    per leg and the mean per-round acceptance are reported
+    (informational on the 1-core CPU box; chip rows land with the
+    next bench round).
+    """
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    if args.serve_target_ckpt:
+        from llm_consensus_tpu.checkpoint.io import (
+            restore_params_for_inference,
+        )
+
+        params, _ = restore_params_for_inference(
+            cfg, args.serve_target_ckpt, jnp.bfloat16
+        )
+    if args.serve_draft == "self":
+        d_cfg, d_params = cfg, params
+    else:
+        d_cfg = get_config(args.serve_draft).with_(use_pallas=cfg.use_pallas)
+        if d_cfg.vocab_size != cfg.vocab_size:
+            print(
+                f"[bench] draft {d_cfg.name} vocab {d_cfg.vocab_size} != "
+                f"target vocab {cfg.vocab_size}",
+                file=sys.stderr,
+            )
+            return 1
+        if args.serve_draft_ckpt:
+            from llm_consensus_tpu.checkpoint.io import (
+                restore_params_for_inference,
+            )
+
+            d_params, _ = restore_params_for_inference(
+                d_cfg, args.serve_draft_ckpt, jnp.bfloat16
+            )
+        else:
+            d_params = init_params(
+                d_cfg, jax.random.PRNGKey(1), dtype=jnp.bfloat16
+            )
+
+    pg = 64
+    k_spec = max(1, args.k_spec)
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    n = args.serve_requests
+    longest = header_target + 64
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    chunk = args.serve_prefill_chunk or 64
+    # Page budget: the speculative round's k+1-token overshoot replaces
+    # steps_per_sync (=1 here — the verify round IS the multi-token
+    # step) as the per-program write unit (_round_tokens).
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], args.new_tokens, k_spec + 1, pg
+    )
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2
+    header = f"Panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    question = " The panel's one question?"
+
+    batcher = ContinuousBatcher(
+        cfg,
+        params,
+        config=ContinuousConfig(
+            max_slots=args.serve_slots,
+            page_size=pg,
+            n_pages=n_pages,
+            pages_per_seq=pages_per_seq,
+            max_new_tokens=args.new_tokens,
+            seq_buckets=tuple(buckets),
+            steps_per_sync=1,
+            prefill_chunk=chunk,
+            share_prefix=True,
+            spec_k=k_spec,
+        ),
+        draft=(d_cfg, d_params),
+    )
+
+    def leg(spec_on, prompts):
+        """One burst; returns (texts, tok/s, per-leg stats deltas)."""
+        batcher.config.spec_decode = spec_on
+        _quiesce_batcher(batcher)
+        s0 = batcher.stats()
+        t0 = time.perf_counter()
+        futs = [
+            batcher.submit(p, max_new_tokens=args.new_tokens)
+            for p in prompts
+        ]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        _quiesce_batcher(batcher)
+        s1 = batcher.stats()
+        d = {k: s1[k] - s0[k] for k in (
+            "generated_tokens",
+            "device_programs_spec",
+            "device_programs_decode",
+            "spec_draft_tokens",
+            "spec_accepted_tokens",
+            "spec_acceptance_sum",
+            "spec_acceptance_count",
+            "spec_shared_draft_rows",
+        )}
+        toks = sum(r.num_tokens for r in results)
+        return [r.text for r in results], toks / wall, d
+
+    panel = [header + question] * n
+    runs = {False: [], True: []}  # spec_on -> [(tok/s, stats delta)]
+    diverged = False
+    try:
+        # Warmup compiles both program families (plain decode, the
+        # spec draft/verify program, draft prefill-chunk mirrors).
+        for on in (True, False):
+            batcher.config.spec_decode = on
+            futs = [
+                batcher.submit(
+                    header + f" warm {on} {i}",
+                    max_new_tokens=args.new_tokens,
+                )
+                for i in range(min(4, n))
+            ]
+            for f in futs:
+                f.result(timeout=600)
+        for r in range(max(1, args.spec_ab_rounds)):
+            order = (False, True) if r % 2 == 0 else (True, False)
+            got = {}
+            for on in order:
+                texts, tps, d = leg(on, panel)
+                got[on] = texts
+                runs[on].append((tps, d))
+            if got[False] != got[True]:
+                diverged = True
+        # Unique-prompt control (spec ON): prompts distinct from byte 0
+        # — no shared pages, no groups, every row drafts for itself.
+        # The panel's draft-tokens-per-generated-token must come in
+        # BELOW this (the shared-stream amortization realized).
+        unique = [
+            f"{i} unique header {salt}-{i}: " + f"context {i} " * 8
+            + "own question?"
+            for i in range(n)
+        ]
+        _, _, d_uniq = leg(True, unique)
+    finally:
+        batcher.close()
+
+    best_off = max(t for t, _ in runs[False])
+    best_on = max(t for t, _ in runs[True])
+    spec_tot = {
+        k: sum(d[k] for _, d in runs[True])
+        for k in runs[True][0][1]
+    }
+    # Verified tokens per spec program: WORST round gates (speculation
+    # must beat one-token-per-program every round, not on average).
+    # Each request's first token is sampled from prefill logits, not
+    # emitted by a spec program — subtract the leg's request count or
+    # a leg truly yielding < 1 token/program could still clear 1.0.
+    tpp = min(
+        (d["generated_tokens"] - n) / max(1, d["device_programs_spec"])
+        for _, d in runs[True]
+    )
+    acc = spec_tot["spec_acceptance_sum"] / max(
+        1, spec_tot["spec_acceptance_count"]
+    )
+    rate_panel = spec_tot["spec_draft_tokens"] / max(
+        1, spec_tot["generated_tokens"]
+    )
+    rate_uniq = d_uniq["spec_draft_tokens"] / max(
+        1, d_uniq["generated_tokens"]
+    )
+    _emit(
+        {
+            "metric": f"serving tok/s, speculative batcher "
+            f"({cfg.name} + draft {d_cfg.name}, "
+            f"{len(runs[True])}x{n} panel reqs, slots={args.serve_slots}, "
+            f"k={k_spec}, decode {args.new_tokens} @ ~{header_target} "
+            f"shared prompts, verified tokens/program {tpp:.2f}, "
+            f"acceptance {acc:.3f}, draft tokens/generated token "
+            f"panel {rate_panel:.2f} vs unique {rate_uniq:.2f}, "
+            f"shared stream rows {spec_tot['spec_shared_draft_rows']}, "
+            f"spec-off best {best_off:.0f} tok/s, "
+            f"text unchanged={not diverged})",
+            "value": round(best_on, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(best_on / max(best_off, 1e-9), 4),
+        },
+        args.out,
+    )
+    if diverged:
+        print(
+            "[bench] GENERATED TEXT DIVERGED between spec_decode on/off "
+            "— speculative-decoding regression",
+            file=sys.stderr,
+        )
+        return 1
+    if tpp <= 1.0:
+        print(
+            f"[bench] spec leg verified {tpp:.3f} tokens per device "
+            "program (gate > 1.0) — speculation is not beating plain "
+            "decode; check draft/target agreement (run --serve-draft "
+            "self or a trained pair)",
+            file=sys.stderr,
+        )
+        return 1
+    if rate_panel >= rate_uniq:
+        print(
+            f"[bench] panel draft rate {rate_panel:.3f} >= unique-"
+            f"control rate {rate_uniq:.3f} — shared draft streams did "
+            "not amortize; resize the leg",
             file=sys.stderr,
         )
         return 1

@@ -164,10 +164,10 @@ def _build_backend(args):
             params = quantize_params(
                 params, bits=8 if args.quant == "int8" else 4
             )
-        if draft is not None:
-            log.warning(
-                "--draft-model is ignored by --backend continuous "
-                "(speculative decoding rides the engine path only)"
+        if draft is not None and args.spec_k <= 0:
+            raise SystemExit(
+                "--draft-model on --backend continuous needs --spec-k > 0 "
+                "(draft tokens proposed per verify round)"
             )
         batcher = ContinuousBatcher(
             cfg,
@@ -181,8 +181,10 @@ def _build_backend(args):
                 host_cache_bytes=args.host_cache_mb << 20,
                 pipeline_depth=args.pipeline_depth,
                 ragged_attention=not args.no_ragged_attention,
+                spec_k=args.spec_k if draft is not None else 0,
             ),
             mesh=mesh,
+            draft=draft,
         )
         return ContinuousBackend(batcher)
     engine = InferenceEngine(
@@ -282,6 +284,17 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "--draft-checkpoint",
         default=None,
         help="orbax checkpoint dir for the draft model's weights",
+    )
+    p.add_argument(
+        "--spec-k",
+        type=int,
+        default=4,
+        help="continuous backend: draft tokens proposed per speculative "
+        "verify round (with --draft-model; the batcher drafts once per "
+        "shared-prefix panel group, verifies all slots' drafts in one "
+        "ragged device program, and rolls back rejected tokens by "
+        "count bookkeeping — greedy output is byte-identical to "
+        "spec-off)",
     )
     p.add_argument(
         "--mesh",

@@ -1,0 +1,205 @@
+"""Speculative-decoding acceptance rules — the ONE shared implementation.
+
+Both speculative consumers verify a draft against the target's logits
+for a whole ``k_spec + 1``-token chunk at once:
+
+- :func:`llm_consensus_tpu.engine.speculative.speculative_generate`,
+  the standalone dense-cache loop (the parity oracle), and
+- the continuous batcher's paged verify program (PR 9,
+  :mod:`llm_consensus_tpu.serving.continuous`), where the accept /
+  rollback decision runs ON DEVICE inside the dispatched program.
+
+This module holds the accept math and nothing else — no model code, no
+generation loop — so the batcher can import it without dragging in the
+standalone ``speculative_generate`` while the two implementations stay
+pinned to the same decisions (tests/test_serve_speculative.py).
+
+Two rules, per row:
+
+- **Greedy** (temperature <= 0): accept draft tokens while they equal
+  the target argmax; the correction token is the argmax at the first
+  mismatch, the BONUS token the argmax at position k on full
+  acceptance. Output is byte-identical to plain greedy decode for ANY
+  draft — the draft only affects speed.
+- **Sampled**: Leviathan et al. acceptance via :func:`leviathan_accept`
+  with the draft's distribution q. The batcher drafts GREEDILY even
+  for sampled rows (q = one-hot at the drafted token), which keeps the
+  draft program sampler-free and the panel's shared draft streams
+  valid across mates with different temperatures/seeds; the rule stays
+  exact — accept with prob p(d), else resample from the residual
+  ``norm(max(p - onehot(d), 0))`` = p conditioned on != d, whose
+  marginal is exactly p.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["leviathan_accept", "verify_row", "verify_tokens"]
+
+_EPS = 1e-20
+
+
+def leviathan_accept(
+    p: jnp.ndarray,
+    q: jnp.ndarray,
+    draft: jnp.ndarray,
+    key: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One Leviathan et al. acceptance decision (pure, testable).
+
+    p: [V] target probs; q: [V] draft probs; draft: scalar token drawn
+    from q. Accept with prob min(1, p[d]/q[d]); on rejection the caller
+    replaces the token with one drawn from the residual
+    ``norm(max(p - q, 0))``. Marginal over (draft, coin, correction) is
+    EXACTLY p — verified by Monte Carlo in tests/test_speculative.py.
+
+    Returns (accept bool, correction token int32).
+    """
+    k_coin, k_corr = jax.random.split(key)
+    ratio = p[draft] / jnp.maximum(q[draft], _EPS)
+    accept = jax.random.uniform(k_coin) < ratio
+    resid = jnp.maximum(p - q, 0.0)
+    total = jnp.sum(resid)
+    # Identical distributions -> empty residual; rejection then has
+    # probability 0, so any valid fallback distribution works.
+    resid = jnp.where(total > _EPS, resid / jnp.maximum(total, _EPS), p)
+    corr = jax.random.categorical(k_corr, jnp.log(jnp.maximum(resid, _EPS)))
+    return accept, corr.astype(jnp.int32)
+
+
+def verify_row(
+    logits: jnp.ndarray,
+    drafts: jnp.ndarray,
+    temperature: jnp.ndarray,
+    keys: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One row's accept/rollback decision over a verify chunk with a
+    GREEDY (deterministic) draft.
+
+    logits: [K+1, V] fp32 target logits — position j conditions on the
+    row's committed tokens plus drafts[:j] (the ragged-causal verify
+    forward); drafts: [K] int32 greedy draft proposals; temperature:
+    scalar (<= 0 = greedy row); keys: [K+1] PRNG keys, one per
+    position (key j must be the SAME (seed, output-index) fold the
+    plain sampler would burn for that token, so per-request streams
+    stay reproducible regardless of speculation).
+
+    Returns (emit [K+1] int32, emit_cnt scalar int32): the accepted
+    draft prefix followed by the correction token at position
+    ``emit_cnt - 1`` (the correction on a mismatch/rejection, the FREE
+    bonus token on full acceptance — Leviathan et al.), pad-free: only
+    ``emit[:emit_cnt]`` is meaningful. Position K of the leviathan
+    call carries zero draft mass, so its residual is exactly the
+    target distribution and ONE vmapped call yields both the K
+    acceptance coins and every candidate correction/bonus token —
+    the same structure as ``speculative_generate``'s sampled verify.
+    """
+    k = drafts.shape[0]
+    v = logits.shape[-1]
+    greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [K+1]
+    greedy = temperature <= 0.0
+    t_eff = jnp.maximum(temperature, 1e-6)
+    p = jax.nn.softmax(logits / t_eff, axis=-1)  # [K+1, V]
+    # Greedy draft == a one-hot draft distribution; the bonus slot
+    # (position K) carries zero mass so its residual is exactly p.
+    q_pad = jnp.concatenate(
+        [jax.nn.one_hot(drafts, v, dtype=p.dtype), jnp.zeros((1, v), p.dtype)]
+    )
+    d_pad = jnp.pad(drafts, (0, 1))  # [K+1]
+    coin, corr = jax.vmap(leviathan_accept)(p, q_pad, d_pad, keys)
+    match = jnp.where(greedy, drafts == greedy_t[:k], coin[:k])
+    acc_mask = jnp.cumprod(match.astype(jnp.int32))  # [K]
+    n_acc = jnp.sum(acc_mask)
+    fix_of = jnp.where(greedy, greedy_t, corr)  # [K+1] per-position fix
+    fix = fix_of[n_acc]
+    j = jnp.arange(k + 1)
+    emit = jnp.where(
+        j < n_acc, d_pad, jnp.where(j == n_acc, fix, jnp.int32(0))
+    ).astype(jnp.int32)
+    return emit, (n_acc + 1).astype(jnp.int32)
+
+
+def verify_tokens(
+    logits: jnp.ndarray,
+    drafts: jnp.ndarray,
+    temps: jnp.ndarray,
+    topks: jnp.ndarray,
+    topps: jnp.ndarray,
+    keys: jax.Array,
+    *,
+    filters_active: bool = False,
+    all_greedy: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The continuous batcher's whole-batch accept/rollback decision.
+
+    logits: [B, K+1, V] fp32 RAW target logits from the ragged verify
+    forward; drafts: [B, K] greedy draft proposals; temps/topks/topps:
+    [B] per-request sampler settings (the batcher's decode-step data);
+    keys: [B, K+1] PRNG keys, key (i, j) the SAME (seed, output-index)
+    fold the plain sampler would burn for that row's token.
+
+    Per row this reproduces :func:`~llm_consensus_tpu.engine.sampler.
+    sample_token_per_request`'s distribution transform — temperature
+    scale, then the shared top-k/top-p filter
+    (:func:`~llm_consensus_tpu.engine.sampler.filter_scaled_logits`,
+    vmapped over the K+1 positions) — and hands the transformed
+    distribution to :func:`verify_row`. With a one-hot draft the
+    acceptance identity holds for ANY target distribution, so filters
+    compose exactly here (unlike the real-draft-distribution case
+    :mod:`llm_consensus_tpu.engine.speculative` documents): accept with
+    prob p'(d), else resample from p' conditioned on != d, marginal
+    exactly p' — the filtered, temperature-scaled target. Greedy rows
+    (temperature <= 0) take the argmax-match rule on the same
+    transformed logits; the filters keep the argmax, so greedy output
+    is byte-identical to the plain sampler's for any draft.
+
+    ``filters_active`` (static) mirrors the batcher's decode-step
+    optimization: False skips the full-vocab sorts entirely.
+    ``all_greedy`` (static): every row has temperature <= 0 — skip the
+    leviathan machinery (softmax p, one-hot q, residual categorical —
+    several full-vocab passes whose outputs the greedy branch would
+    discard) for the pure argmax-chain rule, bit-identical to the
+    general path on greedy rows. The batcher passes both as static jit
+    args (two cached traces each).
+
+    Returns (emit [B, K+1] int32, emit_cnt [B] int32) — see
+    :func:`verify_row`.
+    """
+    b, k1, v = logits.shape
+    temps = jnp.asarray(temps, jnp.float32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None, None]
+    scaled = logits / safe_t
+    if filters_active:
+        from llm_consensus_tpu.engine.sampler import filter_scaled_logits
+
+        flat = filter_scaled_logits(
+            scaled.reshape(b * k1, v),
+            jnp.repeat(jnp.asarray(topks, jnp.int32), k1),
+            jnp.repeat(jnp.asarray(topps, jnp.float32), k1),
+        )
+        scaled = flat.reshape(b, k1, v)
+    if all_greedy:
+        # verify_row's greedy branch, batch-vectorized without the
+        # dead leviathan call (the filters keep the argmax, so this is
+        # transform-invariant like the general path).
+        k = k1 - 1
+        greedy_t = jnp.argmax(scaled, axis=-1).astype(jnp.int32)
+        match = (drafts == greedy_t[:, :k]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+        d_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+        fix = jnp.take_along_axis(greedy_t, n_acc[:, None], axis=1)
+        j = jnp.arange(k1)[None, :]
+        emit = jnp.where(
+            j < n_acc[:, None],
+            d_pad,
+            jnp.where(j == n_acc[:, None], fix, jnp.int32(0)),
+        ).astype(jnp.int32)
+        return emit, (n_acc + 1).astype(jnp.int32)
+    # Scaling already applied: sampled rows verify at temperature 1 on
+    # the transformed logits; greedy rows keep t <= 0 for the argmax
+    # rule (argmax is scale- and filter-invariant, so the transform is
+    # harmless there).
+    t_unit = jnp.where(temps > 0, 1.0, temps)
+    return jax.vmap(verify_row)(scaled, drafts, t_unit, keys)

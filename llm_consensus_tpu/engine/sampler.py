@@ -111,6 +111,46 @@ def sample_token_per_row(
     )
 
 
+def filter_scaled_logits(
+    scaled: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row top-k + nucleus masking of temperature-scaled logits.
+
+    scaled: [B, V]; top_k [B] int32 (0 = off); top_p [B] f32 (1.0 =
+    off). ONE descending sort serves both filters. Extracted from
+    :func:`sample_token_per_request` so the speculative verify path
+    (:func:`llm_consensus_tpu.engine.accept.verify_tokens`) applies the
+    EXACT same filter transform to its per-position target
+    distributions — the two consumers cannot drift.
+    """
+    k = jnp.asarray(top_k, jnp.int32)
+    p = jnp.asarray(top_p, jnp.float32)
+    v = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    # top-k threshold from the shared sort.
+    k_eff = jnp.where(k > 0, jnp.clip(k, 1, v), v)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    filtered = jnp.where(scaled < kth, _NEG_INF, scaled)
+    # Nucleus over the top-k-MASKED distribution (sequential
+    # semantics, matching _apply_top_p(_apply_top_k(...))): mask by
+    # VALUE, not position — the sequential top-k keeps every token
+    # TIED at the kth logit, so the nucleus set must include the
+    # ties too. The value mask is still a prefix of the descending
+    # sort, so one sort serves both filters.
+    in_k = sorted_desc >= kth
+    sorted_k = jnp.where(in_k, sorted_desc, _NEG_INF)
+    sorted_probs = jax.nn.softmax(sorted_k, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep_sorted = ((cum - sorted_probs) < p[:, None]) & in_k
+    min_kept = jnp.min(
+        jnp.where(keep_sorted, sorted_k, jnp.inf),
+        axis=-1,
+        keepdims=True,
+    )
+    nucleus = jnp.where(filtered < min_kept, _NEG_INF, filtered)
+    return jnp.where(p[:, None] >= 1.0, filtered, nucleus)
+
+
 def sample_token_per_request(
     logits: jnp.ndarray,
     keys: jax.Array,
@@ -140,32 +180,7 @@ def sample_token_per_request(
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
     if filters_active:
-        k = jnp.asarray(top_k, jnp.int32)
-        p = jnp.asarray(top_p, jnp.float32)
-        v = scaled.shape[-1]
-        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
-        # top-k threshold from the shared sort.
-        k_eff = jnp.where(k > 0, jnp.clip(k, 1, v), v)
-        kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
-        filtered = jnp.where(scaled < kth, _NEG_INF, scaled)
-        # Nucleus over the top-k-MASKED distribution (sequential
-        # semantics, matching _apply_top_p(_apply_top_k(...))): mask by
-        # VALUE, not position — the sequential top-k keeps every token
-        # TIED at the kth logit, so the nucleus set must include the
-        # ties too. The value mask is still a prefix of the descending
-        # sort, so one sort serves both filters.
-        in_k = sorted_desc >= kth
-        sorted_k = jnp.where(in_k, sorted_desc, _NEG_INF)
-        sorted_probs = jax.nn.softmax(sorted_k, axis=-1)
-        cum = jnp.cumsum(sorted_probs, axis=-1)
-        keep_sorted = ((cum - sorted_probs) < p[:, None]) & in_k
-        min_kept = jnp.min(
-            jnp.where(keep_sorted, sorted_k, jnp.inf),
-            axis=-1,
-            keepdims=True,
-        )
-        nucleus = jnp.where(filtered < min_kept, _NEG_INF, filtered)
-        filtered = jnp.where(p[:, None] >= 1.0, filtered, nucleus)
+        filtered = filter_scaled_logits(scaled, top_k, top_p)
     else:
         filtered = scaled
     sampled = jax.vmap(

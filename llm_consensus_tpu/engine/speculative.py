@@ -25,6 +25,11 @@ Two modes, both exactness-anchored (tests/test_speculative.py):
   temperature scaling; top-k/top-p do not compose with the acceptance
   identity and are not applied here.
 
+The accept rule itself lives in :mod:`llm_consensus_tpu.engine.accept`
+(PR 9) so the continuous batcher's on-device verify program shares it
+without importing this standalone loop; this module keeps being the
+parity oracle the batcher path is pinned against.
+
 bf16 KV caches only (the verification chunk writes ragged per-row
 positions; the int8 head-major scatter isn't worth it on this path).
 
@@ -41,6 +46,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from llm_consensus_tpu.engine.accept import leviathan_accept
 from llm_consensus_tpu.models.cache import KVCache
 from llm_consensus_tpu.models.configs import ModelConfig
 from llm_consensus_tpu.models.transformer import (
@@ -49,36 +55,7 @@ from llm_consensus_tpu.models.transformer import (
     prefill,
 )
 
-
-_EPS = 1e-20
-
-
-def leviathan_accept(
-    p: jnp.ndarray,
-    q: jnp.ndarray,
-    draft: jnp.ndarray,
-    key: jax.Array,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """One Leviathan et al. acceptance decision (pure, testable).
-
-    p: [V] target probs; q: [V] draft probs; draft: scalar token drawn
-    from q. Accept with prob min(1, p[d]/q[d]); on rejection the caller
-    replaces the token with one drawn from the residual
-    ``norm(max(p - q, 0))``. Marginal over (draft, coin, correction) is
-    EXACTLY p — verified by Monte Carlo in tests/test_speculative.py.
-
-    Returns (accept bool, correction token int32).
-    """
-    k_coin, k_corr = jax.random.split(key)
-    ratio = p[draft] / jnp.maximum(q[draft], _EPS)
-    accept = jax.random.uniform(k_coin) < ratio
-    resid = jnp.maximum(p - q, 0.0)
-    total = jnp.sum(resid)
-    # Identical distributions -> empty residual; rejection then has
-    # probability 0, so any valid fallback distribution works.
-    resid = jnp.where(total > _EPS, resid / jnp.maximum(total, _EPS), p)
-    corr = jax.random.categorical(k_corr, jnp.log(jnp.maximum(resid, _EPS)))
-    return accept, corr.astype(jnp.int32)
+__all__ = ["SpecOutput", "leviathan_accept", "speculative_generate"]
 
 
 @jax.tree_util.register_dataclass

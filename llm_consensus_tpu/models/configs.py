@@ -310,6 +310,20 @@ PRESETS: dict[str, ModelConfig] = {
         d_ff=128,
         max_seq_len=128,
     ),
+    # Draft-sized sibling of test-tiny (same vocab — the one hard
+    # requirement for speculation): the continuous batcher's
+    # draft/verify tests and the CPU smoke of `bench.py
+    # --serve-speculative` run this as the cheap proposal model.
+    "test-tiny-draft": ModelConfig(
+        name="test-tiny-draft",
+        vocab_size=384,
+        d_model=32,
+        n_layers=1,
+        n_heads=2,
+        n_kv_heads=1,
+        d_ff=64,
+        max_seq_len=128,
+    ),
     "test-tiny-moe": ModelConfig(
         name="test-tiny-moe",
         vocab_size=384,

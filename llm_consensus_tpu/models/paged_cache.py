@@ -655,6 +655,18 @@ class GroupTracker:
         if self._run_of_seq.pop(seq_id, None) is not None:
             self._dirty = True
 
+    def stream_buckets(self) -> list[list[int]]:
+        """Registered seqs bucketed by shared FIRST prefix page — the
+        candidate sets for panel-shared draft streams (PR 9: members of
+        one bucket decode over one prompt header, so a donor's
+        committed-suffix + fresh-draft stream is reusable by any mate
+        whose committed text still agrees). First-page granularity like
+        :meth:`arrays`' grouping; only >= 2-member buckets return."""
+        buckets: dict[int, list[int]] = {}
+        for seq, run in self._run_of_seq.items():
+            buckets.setdefault(run[0], []).append(seq)
+        return [sorted(s) for s in buckets.values() if len(s) >= 2]
+
     @staticmethod
     def _common_prefix(runs: list[tuple[int, ...]]) -> int:
         k = 0

@@ -1118,6 +1118,82 @@ def decode_step_paged(
     return logits, new_cache
 
 
+def verify_step_paged(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    cache,
+    groups=None,
+) -> tuple[jnp.ndarray, object]:
+    """Speculative VERIFY step: NQ tokens per cache sequence, one
+    program (PR 9 — :func:`decode_step_paged` widened to k+1-token
+    ragged rows).
+
+    tokens: [max_seqs, NQ] — row b's previous committed token followed
+    by NQ-1 draft proposals, at absolute positions ``length[b] + i``.
+    Embedding, RoPE, the QKV/WO/MLP matmuls, and the K/V pool scatter
+    all run over the [B, NQ] token grid (one weight read serves NQ
+    tokens per row — the point of speculation), and attention is the
+    ragged kernel's verify lane: queries at ``valid_len - NQ + i`` with
+    the chunk lane's ragged-causal rule, so position j conditions on
+    the row's committed tokens plus drafts[:j]. K/V for ALL NQ
+    positions are written through the row's table (decode rows write
+    only private pages — shared prefix pages cover prompts only);
+    positions past the eventually-accepted prefix hold garbage the
+    caller truncates by REWINDING ``length``, never by copying pages —
+    slots past ``length`` are invisible to every later read and get
+    overwritten by later writes, exactly like a mid-chunk retirement's
+    overshoot tokens.
+
+    Returns (logits [max_seqs, NQ, V] fp32 — one distribution per
+    verify position, the accept rule's input — and the cache with
+    ``length`` UNCHANGED: the caller advances it by each row's emitted
+    count after the accept decision). ``groups`` as in
+    :func:`decode_step_paged` (every verify query of a member stacks
+    against one read of the shared run).
+    """
+    from llm_consensus_tpu.models.paged_cache import PagedKVCache
+
+    b, nq = tokens.shape
+    pos0 = cache.length  # [B] first write position per row
+    pos = pos0[:, None] + jnp.arange(nq)[None]  # [B, NQ]
+    x = params["embed"][tokens]  # [B, NQ, D]
+    cos, sin = rope_cos_sin(
+        pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+    pg = cache.page_size
+    pages = jnp.take_along_axis(
+        cache.page_table, pos // pg, axis=1
+    )  # [B, NQ] destination page per token
+    offs = pos % pg
+    tables = cache.page_table
+
+    def body(carry, layer_in):
+        p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
+        h = _rms(cfg, carry, p["attn_norm"])
+        q, k, v = _project_qkv(cfg, p, h)  # [B, NQ, H, Dh]
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pool = k_pool.at[pages, offs].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[pages, offs].set(v.astype(v_pool.dtype))
+        attn = _attn_paged(
+            cfg, q, None, k_pool, v_pool, tables, pos0 + nq, groups=groups
+        )  # [B, NQ, H, D]
+        y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
+        h2 = _rms(cfg, y, p["mlp_norm"])
+        y = y + _mlp(cfg, p, h2)
+        return y, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    logits = _unembed(cfg, params, x)  # [B, NQ, V]
+    new_cache = PagedKVCache(
+        k=new_k, v=new_v, page_table=cache.page_table, length=cache.length
+    )
+    return logits, new_cache
+
+
 def prefill_chunk_paged(
     cfg: ModelConfig,
     params: dict,

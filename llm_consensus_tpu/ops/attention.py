@@ -292,17 +292,32 @@ def ragged_paged_attention_reference(
     exist here — the kernel's grouped output must match this ungrouped
     math (the PR 3 contract, extended to mixed rows).
 
-    q: [B, H, D]; k_pool/v_pool: [n_pages, page, Hkv, D]; page_table:
-    [B, P]; valid_len: [B]. Returns out_dec [B, H, D] (and out_chunk
+    q: [B, H, D] — one query per decode row — or [B, NQ, H, D]:
+    NQ-token speculative VERIFY rows (PR 9), row b's queries at
+    positions ``valid_len[b] - NQ + i`` (``valid_len`` stays "tokens
+    readable", the NQ new tokens' K/V already written), masked by
+    :func:`chunk_decode_attention`'s ragged-causal rule per row — a
+    verify row is exactly a chunk row over the row's own table.
+    k_pool/v_pool: [n_pages, page, Hkv, D]; page_table: [B, P];
+    valid_len: [B]. Returns out_dec shaped like ``q`` (and out_chunk
     [C, H, D] when ``q_chunk`` is given).
     """
-    b, h, d = q.shape
+    nq = None
+    if q.ndim == 4:
+        b, nq, h, d = q.shape
+    else:
+        b, h, d = q.shape
     hkv = k_pool.shape[2]
     k_seq = k_pool[page_table].reshape(b, -1, hkv, d)
     v_seq = v_pool[page_table].reshape(b, -1, hkv, d)
-    out = decode_attention(q[:, None], k_seq, v_seq, valid_len, window=window)[
-        :, 0
-    ]
+    if nq is None:
+        out = decode_attention(
+            q[:, None], k_seq, v_seq, valid_len, window=window
+        )[:, 0]
+    else:
+        out = chunk_decode_attention(
+            q, k_seq, v_seq, valid_len - nq, window=window
+        )
     if q_chunk is None:
         return out
     kc = k_pool[chunk_table].reshape(1, -1, hkv, d)

@@ -655,6 +655,7 @@ def _ragged_kernel(
     d: int,
     nc: int,
     cq: int,
+    nq: int,
     gm: int,
     pg: int,
     p_per: int,
@@ -663,6 +664,13 @@ def _ragged_kernel(
     stacked: bool,
 ):
     """One (program-class row, page) step of the ragged kernel.
+
+    ``nq`` (static, default 1): queries per DECODE row. > 1 is the
+    speculative-verify lane (PR 9): row b carries its previous token
+    plus k draft tokens at positions ``kvlen[b] - nq + i``, masked by
+    the same ragged-causal rule as the chunk lane — a verify row IS a
+    chunk row over the row's own table, which is why the one kernel
+    body serves both.
 
     ``refs`` is parsed positionally by the same static layout the
     wrapper builds: scalar prefetch ([layer?], tbl, kvlen, sstart,
@@ -756,21 +764,37 @@ def _ragged_kernel(
     @pl.when(s < b)
     def _decode_row():
         valid = kvlen_ref[s]
+        qbase = valid - nq  # first query's absolute position
         lo = sstart_ref[s]
+        lo_all = lo
         if window > 0:
-            # Sliding window: the single query sits at valid - 1 and
-            # sees slots [valid - window, valid) — same rule as
-            # ops.attention.decode_attention.
-            lo = jnp.maximum(lo, valid - window)
-        live = ((j + 1) * pg > lo) & (j * pg < valid)
+            # Sliding window: query i sits at qbase + i and sees slots
+            # (qbase + i - window, qbase + i] — the union of the nq
+            # windows starts at the FIRST query's edge (nq == 1
+            # reduces to ops.attention.decode_attention's rule).
+            lo_all = jnp.maximum(lo, qbase + 1 - window)
+        live = ((j + 1) * pg > lo_all) & (j * pg < valid)
 
         @pl.when(live)
         def _fold_page():
-            slot = j * pg + jax.lax.broadcasted_iota(jnp.int32, (1, pg), 1)
-            mask = (slot >= lo) & (slot < valid)
+            slot = j * pg + jax.lax.broadcasted_iota(
+                jnp.int32, (nq, 1, pg), 2
+            )
+            qpos = qbase + jax.lax.broadcasted_iota(
+                jnp.int32, (nq, 1, pg), 0
+            )
+            # Ragged causal: query i sees slots <= its own position —
+            # chunk_decode_attention's rule; nq == 1 is the classic
+            # slot < valid decode mask.
+            mask3 = (slot <= qpos) & (slot >= lo)
+            if window > 0:
+                mask3 &= slot > qpos - window
+            mask = jnp.broadcast_to(mask3, (nq, g, pg)).reshape(
+                nq * g, pg
+            )
             for head in range(hkv):  # static unroll over kv heads
                 _fold(
-                    slice(head * g, (head + 1) * g),
+                    slice(head * nq * g, (head + 1) * nq * g),
                     q_dec_ref[0, head],
                     head,
                     mask,
@@ -825,9 +849,9 @@ def _ragged_kernel(
         # Group programs run LAST; their accumulator spans all of them.
         @pl.when((s == R) & (j == 0))
         def _init_group():
-            m2_s[...] = jnp.full((hkv, b * g, 1), _NEG_INF, jnp.float32)
-            l2_s[...] = jnp.zeros((hkv, b * g, 1), jnp.float32)
-            acc2_s[...] = jnp.zeros((hkv, b * g, d), jnp.float32)
+            m2_s[...] = jnp.full((hkv, b * nq * g, 1), _NEG_INF, jnp.float32)
+            l2_s[...] = jnp.zeros((hkv, b * nq * g, 1), jnp.float32)
+            acc2_s[...] = jnp.zeros((hkv, b * nq * g, d), jnp.float32)
 
         @pl.when(s >= R)
         def _group():
@@ -837,17 +861,31 @@ def _ragged_kernel(
             @pl.when(j * pg < ge)
             def _fold_page():
                 member = gid_ref[...] == gi  # [B, 1]
-                mrow = jnp.broadcast_to(member, (b, g)).reshape(b * g, 1)
+                mrow = jnp.broadcast_to(
+                    member[:, None], (b, nq, g)
+                ).reshape(b * nq * g, 1)
                 slot = j * pg + jax.lax.broadcasted_iota(
                     jnp.int32, (1, pg), 1
                 )
+                # Every decode query sits past the shared run's end
+                # (shared pages cover prompt prefixes only), so the
+                # causal limit never binds here — mask is membership +
+                # run extent, for all nq queries alike.
                 mask = mrow & (slot < ge)
                 if window > 0:
-                    # Per-member window edge: members of one group can
-                    # sit at different fills.
-                    wlo = jnp.broadcast_to(
-                        kvv_ref[...] - window, (b, g)
-                    ).reshape(b * g, 1)
+                    # Per-member, per-query window edge: members of one
+                    # group can sit at different fills, and the nq
+                    # verify queries of one member at different
+                    # positions.
+                    qoff = jax.lax.broadcasted_iota(
+                        jnp.int32, (b, nq, g), 1
+                    )
+                    kvv = jnp.broadcast_to(
+                        kvv_ref[...][:, :, None], (b, nq, g)
+                    )
+                    wlo = (kvv - nq + qoff + 1 - window).reshape(
+                        b * nq * g, 1
+                    )
                     mask &= slot >= wlo
                 for head in range(hkv):  # static unroll over kv heads
                     _fold(
@@ -858,24 +896,28 @@ def _ragged_kernel(
 
     @pl.when((s < b) & (j == p_per - 1))
     def _write_dec():
-        m = m_s[0 : hkv * g]
-        l = l_s[0 : hkv * g]
+        m = m_s[0 : hkv * nq * g]
+        l = l_s[0 : hkv * nq * g]
         md_ref[0] = m
         ld_ref[0] = l
         od_ref[0] = (
-            acc_s[0 : hkv * g] / jnp.maximum(l, 1e-30)
-        ).reshape(hkv, g, d)
+            acc_s[0 : hkv * nq * g] / jnp.maximum(l, 1e-30)
+        ).reshape(hkv, nq * g, d)
 
     if nc:
 
         @pl.when((s == b) & (j == p_per - 1))
         def _write_chunk():
-            l = l_s[...]
-            mc_ref[0] = m_s[...]
+            # Slice, never [...]: the scratch is sized for the WIDER of
+            # the chunk lane (cq) and the verify lane (nq) — with nq >
+            # cq the chunk's rows are the leading hkv * cq * g.
+            m = m_s[0 : hkv * cq * g]
+            l = l_s[0 : hkv * cq * g]
+            mc_ref[0] = m
             lc_ref[0] = l
-            oc_ref[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).reshape(
-                hkv, cq * g, d
-            )
+            oc_ref[0] = (
+                acc_s[0 : hkv * cq * g] / jnp.maximum(l, 1e-30)
+            ).reshape(hkv, cq * g, d)
 
     if gm:
 
@@ -908,16 +950,24 @@ def _ragged_attention(
 ):
     """Assemble and launch ONE ragged program; merge group partials.
 
-    q_dec: [B, H, D]; page_table: [B + nc, P] (row B is the chunk's
-    table when ``q_chunk`` [C, H, D] rides along); kv_len/suffix_start:
-    [B + nc]. K/V layout is static: the pool [n_pages, pg, Hkv, D]
-    (``k_scale`` None), the int8 head-major cache [B, Hkv, S, D] with
-    [B, Hkv, S] scales, or the stacked int8 cache [L, B, Hkv, S, D]
-    (``layer`` a traced index) — the dense layouts are addressed as
-    identity-tabled virtual pages of width ``pg``. Returns out_dec
-    [B, H, D] (and out_chunk [C, H, D] when ``q_chunk``) in q's dtype.
+    q_dec: [B, H, D] (one query per decode row) or [B, NQ, H, D]
+    (NQ-query verify rows, PR 9 — queries at kv_len - NQ + i, the
+    chunk lane's ragged-causal rule per row); page_table: [B + nc, P]
+    (row B is the chunk's table when ``q_chunk`` [C, H, D] rides
+    along); kv_len/suffix_start: [B + nc]. K/V layout is static: the
+    pool [n_pages, pg, Hkv, D] (``k_scale`` None), the int8 head-major
+    cache [B, Hkv, S, D] with [B, Hkv, S] scales, or the stacked int8
+    cache [L, B, Hkv, S, D] (``layer`` a traced index) — the dense
+    layouts are addressed as identity-tabled virtual pages of width
+    ``pg``. Returns out_dec shaped like q_dec (and out_chunk [C, H, D]
+    when ``q_chunk``) in q's dtype.
     """
-    b, h, d = q_dec.shape
+    squeeze_nq = q_dec.ndim == 3
+    if squeeze_nq:
+        b, h, d = q_dec.shape
+        nq = 1
+    else:
+        b, nq, h, d = q_dec.shape
     quant = k_scale is not None
     stacked = layer is not None
     if quant:
@@ -957,8 +1007,8 @@ def _ragged_attention(
         row = jnp.where(s < R, s, 0)
         lo = sst[row]
         if window > 0:
-            nq = jnp.where(row < b, 1, cq) if nc else 1
-            lo = jnp.maximum(lo, kvl[row] - (nq - 1) - window)
+            nq_row = jnp.where(row < b, nq, cq) if nc else nq
+            lo = jnp.maximum(lo, kvl[row] - (nq_row - 1) - window)
         live = ((j + 1) * pg > lo) & (j * pg < kvl[row])
         page = jnp.where(live, tbl[row * p_per + j], 0)
         if gm:
@@ -991,10 +1041,13 @@ def _ragged_attention(
         in_specs.append(pl.BlockSpec((b, 1), lambda s, j, *pf: (0, 0)))
         inputs.append(kvlen[:b].reshape(b, 1))
         in_specs.append(pl.BlockSpec((b, 1), lambda s, j, *pf: (0, 0)))
-    inputs.append(q_dec.reshape(b, hkv, g, d))
+    # Per-row q block rows are (nq, g)-ordered — the order the decode
+    # fold's mask reshape and the write-out both assume.
+    q4 = q_dec.reshape(b, nq, hkv, g, d)
+    inputs.append(q4.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nq * g, d))
     in_specs.append(
         pl.BlockSpec(
-            (1, hkv, g, d),
+            (1, hkv, nq * g, d),
             lambda s, j, *pf: (jnp.where(s < b, s, 0), 0, 0, 0),
         )
     )
@@ -1011,12 +1064,12 @@ def _ragged_attention(
         )
     if gm:
         inputs.append(
-            q_dec.reshape(b, hkv, g, d)
-            .transpose(1, 0, 2, 3)
-            .reshape(hkv, b * g, d)
+            q4.transpose(2, 0, 1, 3, 4).reshape(hkv, b * nq * g, d)
         )
         in_specs.append(
-            pl.BlockSpec((hkv, b * g, d), lambda s, j, *pf: (0, 0, 0))
+            pl.BlockSpec(
+                (hkv, b * nq * g, d), lambda s, j, *pf: (0, 0, 0)
+            )
         )
     if quant:
         if stacked:
@@ -1043,14 +1096,14 @@ def _ragged_attention(
         return (jnp.where(s < b, s, b), 0, 0, 0)
 
     out_shapes = [
-        jax.ShapeDtypeStruct((b + 1, hkv * g, 1), jnp.float32),
-        jax.ShapeDtypeStruct((b + 1, hkv * g, 1), jnp.float32),
-        jax.ShapeDtypeStruct((b + 1, hkv, g, d), jnp.float32),
+        jax.ShapeDtypeStruct((b + 1, hkv * nq * g, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b + 1, hkv * nq * g, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b + 1, hkv, nq * g, d), jnp.float32),
     ]
     out_specs = [
-        pl.BlockSpec((1, hkv * g, 1), _dec_out_map3),
-        pl.BlockSpec((1, hkv * g, 1), _dec_out_map3),
-        pl.BlockSpec((1, hkv, g, d), _dec_out_map4),
+        pl.BlockSpec((1, hkv * nq * g, 1), _dec_out_map3),
+        pl.BlockSpec((1, hkv * nq * g, 1), _dec_out_map3),
+        pl.BlockSpec((1, hkv, nq * g, d), _dec_out_map4),
     ]
     if nc:
 
@@ -1072,17 +1125,17 @@ def _ragged_attention(
         ]
     if gm:
         out_shapes += [
-            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((hkv, b * g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((hkv, b * g, d), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * nq * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * nq * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, b * nq * g, d), jnp.float32),
         ]
         out_specs += [
-            pl.BlockSpec((hkv, b * g, 1), lambda s, j, *pf: (0, 0, 0)),
-            pl.BlockSpec((hkv, b * g, 1), lambda s, j, *pf: (0, 0, 0)),
-            pl.BlockSpec((hkv, b * g, d), lambda s, j, *pf: (0, 0, 0)),
+            pl.BlockSpec((hkv, b * nq * g, 1), lambda s, j, *pf: (0, 0, 0)),
+            pl.BlockSpec((hkv, b * nq * g, 1), lambda s, j, *pf: (0, 0, 0)),
+            pl.BlockSpec((hkv, b * nq * g, d), lambda s, j, *pf: (0, 0, 0)),
         ]
 
-    qs = cq if nc else 1
+    qs = max(nq, cq if nc else 1)
     scratch = [
         pltpu.VMEM((hkv * qs * g, 1), jnp.float32),
         pltpu.VMEM((hkv * qs * g, 1), jnp.float32),
@@ -1090,9 +1143,9 @@ def _ragged_attention(
     ]
     if gm:
         scratch += [
-            pltpu.VMEM((hkv, b * g, 1), jnp.float32),
-            pltpu.VMEM((hkv, b * g, 1), jnp.float32),
-            pltpu.VMEM((hkv, b * g, d), jnp.float32),
+            pltpu.VMEM((hkv, b * nq * g, 1), jnp.float32),
+            pltpu.VMEM((hkv, b * nq * g, 1), jnp.float32),
+            pltpu.VMEM((hkv, b * nq * g, d), jnp.float32),
         ]
 
     outs = pl.pallas_call(
@@ -1105,6 +1158,7 @@ def _ragged_attention(
             d=d,
             nc=nc,
             cq=cq,
+            nq=nq,
             gm=gm,
             pg=pg,
             p_per=p_per,
@@ -1124,19 +1178,26 @@ def _ragged_attention(
     )(*pf, *inputs)
 
     md, ld, od = outs[0][:b], outs[1][:b], outs[2][:b]
+    od5 = od.reshape(b, hkv, nq, g, d)
     if gm:
         from llm_consensus_tpu.ops.attention import merge_decode_partials
 
         mg, lg, og = outs[-3], outs[-2], outs[-1]
-        m1r = mg.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
-        l1r = lg.reshape(hkv, b, g, 1).transpose(1, 0, 2, 3)
-        o1r = og.reshape(hkv, b, g, d).transpose(1, 0, 2, 3)
-        m2r = md.reshape(b, hkv, g, 1)
-        l2r = ld.reshape(b, hkv, g, 1)
-        out_dec = merge_decode_partials(m1r, l1r, o1r, m2r, l2r, od)
-        out_dec = out_dec.reshape(b, h, d).astype(q_dec.dtype)
+        m1r = mg.reshape(hkv, b, nq, g, 1).transpose(1, 0, 2, 3, 4)
+        l1r = lg.reshape(hkv, b, nq, g, 1).transpose(1, 0, 2, 3, 4)
+        o1r = og.reshape(hkv, b, nq, g, d).transpose(1, 0, 2, 3, 4)
+        m2r = md.reshape(b, hkv, nq, g, 1)
+        l2r = ld.reshape(b, hkv, nq, g, 1)
+        out5 = merge_decode_partials(m1r, l1r, o1r, m2r, l2r, od5)
     else:
-        out_dec = od.reshape(b, h, d).astype(q_dec.dtype)
+        out5 = od5
+    out_dec = (
+        out5.transpose(0, 2, 1, 3, 4)
+        .reshape(b, nq, h, d)
+        .astype(q_dec.dtype)
+    )
+    if squeeze_nq:
+        out_dec = out_dec[:, 0]
     if not nc:
         return out_dec
     oc = outs[5][0]  # [Hkv, cq*G, D]
@@ -1165,9 +1226,13 @@ def ragged_paged_attention(
 ):
     """Mixed prefill+decode attention over the page pool — ONE program.
 
-    q: [B, H, D] decode-row queries; k_pool/v_pool: [n_pages, page,
-    Hkv, D]; page_table: [B, P]; valid_len: [B] tokens readable per
-    decode row.
+    q: [B, H, D] decode-row queries, or [B, NQ, H, D] NQ-token
+    speculative VERIFY rows (PR 9): row b's queries sit at absolute
+    positions ``valid_len[b] - NQ + i`` (``valid_len`` stays "tokens
+    readable" — the NQ new tokens' K/V already written), masked by the
+    chunk lane's ragged-causal rule per row. k_pool/v_pool: [n_pages,
+    page, Hkv, D]; page_table: [B, P]; valid_len: [B] tokens readable
+    per decode row.
 
     ``q_chunk`` [C, H, D] adds ONE prefill-chunk row: C queries at
     absolute positions ``chunk_start + i``, walking ``chunk_table``

@@ -56,6 +56,11 @@ __all__ = [
     "DISPATCH_INFLIGHT",
     "DEVICE_PROGRAMS",
     "RAGGED_ROWS",
+    "SPEC_DRAFT_TOKENS",
+    "SPEC_ACCEPTED_TOKENS",
+    "SPEC_ACCEPTANCE",
+    "SPEC_VERIFIED_TOKENS",
+    "ACCEPTANCE_BUCKETS",
     "TRACE_DROPPED",
     "PREFIX_PAGES_SHARED",
     "PREFIX_PAGES_COPIED",
@@ -84,6 +89,8 @@ THROUGHPUT_BUCKETS = (
 )
 # Batch-occupancy: requests packed per executed program/step.
 OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+# Fractions in [0, 1]: speculative-decoding acceptance per verify round.
+ACCEPTANCE_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 1.0)
 
 
 def _fmt(v: float) -> str:
@@ -548,11 +555,13 @@ PIPELINE_FLUSHES = REGISTRY.counter(
 #: Fused scheduler step (PR 8): device programs the scheduler loop
 #: dispatched, labeled ``kind="fused"`` (one program carrying the
 #: step's decode rows AND a prefill chunk — the ragged-attention
-#: target state), ``kind="decode"`` (decode rows only) or
+#: target state), ``kind="decode"`` (decode rows only),
 #: ``kind="prefill"`` (a standalone prefill program: a chunk with no
-#: decode batch to ride, or the legacy dense path). Programs per
-#: scheduler iteration == 1 is the fusion working; 2 is the pre-ragged
-#: "one chunk program + one decode program" serialization.
+#: decode batch to ride, or the legacy dense path), ``kind="spec"``
+#: (PR 9: one speculative draft+verify+accept round), or
+#: ``kind="draft"`` (the draft model's mirror of a prefill). Programs
+#: per scheduler iteration == 1 is the fusion working; 2 is the
+#: pre-ragged "one chunk program + one decode program" serialization.
 DEVICE_PROGRAMS = REGISTRY.counter(
     "gateway_device_programs_total",
     "Device programs dispatched by the continuous-batcher scheduler loop",
@@ -564,6 +573,33 @@ RAGGED_ROWS = REGISTRY.histogram(
     "gateway_ragged_rows_per_program",
     "Rows (decode rows + fused prefill-chunk lanes) per device program",
     buckets=OCCUPANCY_BUCKETS,
+)
+#: Speculative decoding inside the continuous batcher (PR 9). The
+#: draft proposes ``spec_k`` tokens per round — ONE stream per
+#: shared-prefix panel group (mates whose committed text still agrees
+#: with their donor's reuse its stream), so ``drafted`` counts k per
+#: STREAM, not per row; the target verifies all rows' drafts through
+#: the ragged k+1-token rows of one device program and the leviathan
+#: accept rule emits the accepted prefix + a correction/bonus token.
+#: acceptance = accepted / (k * rows) per round; verified_tokens is the
+#: last spec program's total emitted tokens (tokens-per-device-program
+#: > 1 is speculation beating the one-token-per-program roofline).
+SPEC_DRAFT_TOKENS = REGISTRY.counter(
+    "gateway_spec_draft_tokens_total",
+    "Draft tokens proposed by speculative decoding (k per stream/round)",
+)
+SPEC_ACCEPTED_TOKENS = REGISTRY.counter(
+    "gateway_spec_accepted_tokens_total",
+    "Draft tokens the target's verify rounds accepted",
+)
+SPEC_ACCEPTANCE = REGISTRY.histogram(
+    "gateway_spec_acceptance",
+    "Per-round draft acceptance fraction (accepted / (spec_k * rows))",
+    buckets=ACCEPTANCE_BUCKETS,
+)
+SPEC_VERIFIED_TOKENS = REGISTRY.gauge(
+    "gateway_spec_verified_tokens",
+    "Tokens emitted by the most recent speculative verify program",
 )
 #: Consensus protocol phase latency, labeled
 #: ``phase="propose"|"evaluate"|"refine"`` — one observation per phase

@@ -110,6 +110,7 @@ from llm_consensus_tpu.models.paged_cache import (
     release_seq,
     write_prefill_kv,
 )
+from llm_consensus_tpu.engine.accept import verify_tokens
 from llm_consensus_tpu.serving.offload import HostPageStore
 from llm_consensus_tpu.models.transformer import (
     decode_step_paged,
@@ -117,6 +118,7 @@ from llm_consensus_tpu.models.transformer import (
     prefill,
     prefill_chunk_paged,
     unembed_one,
+    verify_step_paged,
 )
 from llm_consensus_tpu.server.metrics import (
     PREFILL_STALL_SECONDS as _M_PREFILL_STALL,
@@ -171,6 +173,18 @@ from llm_consensus_tpu.server.metrics import (
 )
 from llm_consensus_tpu.server.metrics import (
     RAGGED_ROWS as _M_RAGGED_ROWS,
+)
+from llm_consensus_tpu.server.metrics import (
+    SPEC_DRAFT_TOKENS as _M_SPEC_DRAFTED,
+)
+from llm_consensus_tpu.server.metrics import (
+    SPEC_ACCEPTED_TOKENS as _M_SPEC_ACCEPTED,
+)
+from llm_consensus_tpu.server.metrics import (
+    SPEC_ACCEPTANCE as _M_SPEC_ACCEPTANCE,
+)
+from llm_consensus_tpu.server.metrics import (
+    SPEC_VERIFIED_TOKENS as _M_SPEC_VERIFIED,
 )
 from llm_consensus_tpu.server.metrics import (
     SERVING_ACTIVE as _M_ACTIVE,
@@ -289,6 +303,35 @@ class ContinuousConfig:
     # way). Read per loop iteration — flipping it between bursts needs
     # no new batcher.
     ragged_attention: bool = True
+    # Speculative decoding inside the batcher (PR 9): draft tokens
+    # proposed per scheduler round. With spec_k > 0 AND a draft model
+    # passed to the batcher (``ContinuousBatcher(draft=(cfg, params))``,
+    # ``serve --draft-model/--spec-k``), each round dispatches ONE
+    # device program that (a) runs spec_k + 1 greedy draft steps on the
+    # draft's mirror of the page pool — one shared draft stream per
+    # shared-prefix group: a panel mate whose committed text still
+    # agrees with its group donor's reuses the donor's committed
+    # suffix + fresh drafts instead of drafting itself — (b) verifies
+    # all rows' drafts through the target's k+1-token ragged verify
+    # rows (shared embed/QKV/WO/MLP GEMMs over the widened token axis,
+    # speculative K/V scattered into the pool), and (c) applies the
+    # leviathan accept/rollback rule ON DEVICE, emitting the accepted
+    # prefix + correction/bonus token per row. Rollback is pure count
+    # bookkeeping — ``length`` rewinds; rejected K/V sits past every
+    # later read in private pages and is overwritten, exactly like
+    # mid-chunk retirement overshoot. Greedy output is byte-identical
+    # to spec-off for ANY draft; sampled rows use the exact one-hot
+    # residual correction (engine/accept.py). spec_k feeds the
+    # page-overshoot budget of every admission, so it must not be
+    # flipped live — ``spec_decode`` below is the A/B lever. Engages
+    # off-mesh with steps_per_sync == 1 (the verify round IS the
+    # multi-token step).
+    spec_k: int = 0
+    # Live on/off lever for speculation, read per loop iteration (the
+    # bench flips THIS between bursts on one batcher; a flip drains the
+    # dispatch pipeline so plain and spec programs never share a
+    # window). No effect without spec_k > 0 + a draft model.
+    spec_decode: bool = True
 
 
 @dataclass
@@ -348,6 +391,13 @@ class _Slot:
     # Nodes THIS sequence registered, with the prompt position whose
     # write completes them: [(node, end_pos)].
     reg_nodes: list = field(default_factory=list)
+    # Tokens the TARGET committed through plain decode programs that
+    # the draft mirror never saw (spec_decode flipped off mid-decode
+    # with a draft configured). The next spec engagement replays them
+    # through the draft before dispatching (:meth:`_spec_catch_up`) —
+    # without the replay the draft would write this row's next K/V at
+    # stale positions and its proposals would silently stop accepting.
+    draft_lag: int = 0
 
 
 @dataclass
@@ -386,6 +436,17 @@ class _Inflight:
     k: int  # decode steps folded into this program
     rows: list  # [(slot_idx, _Slot)] decoding at dispatch
     chunk: _InflightChunk | None = None  # fused prefill chunk (PR 8)
+    # -- speculative round (PR 9) --------------------------------------
+    # ``tokens`` is then the [slots, spec_k + 1] emit buffer; only
+    # ``emit_cnt`` leading tokens per row are real. ``counts_out`` is
+    # the device-resident post-round PRNG index row the NEXT spec
+    # dispatch consumes (counts become data-dependent under
+    # accept/rollback, so the host mirror syncs at fetch, not at
+    # dispatch).
+    spec: bool = False
+    spec_k: int = 0
+    emit_cnt: object = None  # device [slots] emitted-token counts
+    counts_out: object = None  # device [slots] post-round PRNG counts
 
 
 class ContinuousBatcher:
@@ -398,12 +459,56 @@ class ContinuousBatcher:
         tokenizer: Tokenizer | None = None,
         config: ContinuousConfig | None = None,
         mesh=None,
+        draft: tuple[ModelConfig, dict] | None = None,
     ):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
         self.config = config or ContinuousConfig()
         c = self.config
+        # Speculative draft model (PR 9): the draft decodes against its
+        # OWN pool mirroring the target's page geometry — same page
+        # ids, same host-side tables/allocator, so prefix sharing, CoW
+        # copies, and host-tier restores cover both pools with one set
+        # of bookkeeping. Draft prefill rides every prompt (chunked or
+        # dense) whenever the draft exists, so flipping ``spec_decode``
+        # mid-serve never leaves a prompt without draft context.
+        self._draft_cfg: ModelConfig | None = None
+        self._draft_params: dict | None = None
+        self.draft_cache = None
+        if draft is not None:
+            dcfg, dparams = draft
+            if c.spec_k <= 0:
+                raise ValueError(
+                    "a draft model needs spec_k > 0 (spec_k sizes the "
+                    "page-overshoot budget and the verify program)"
+                )
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size} — speculation needs one tokenizer"
+                )
+            if mesh is not None:
+                log.warning(
+                    "speculative decoding does not engage on a mesh "
+                    "(open item 1's sharding refactor); draft ignored"
+                )
+            else:
+                if c.steps_per_sync > 1:
+                    # Not an error: spec_decode is a live lever and the
+                    # draft pool/prefills are still maintained — but a
+                    # config that can never verify pays the full draft
+                    # cost (HBM planes + one mirror program per chunk)
+                    # for zero speedup, silently.
+                    log.warning(
+                        "speculative decoding engages only with "
+                        "steps_per_sync == 1 (got %d): the draft will "
+                        "prefill but no verify round will ever "
+                        "dispatch",
+                        c.steps_per_sync,
+                    )
+                self._draft_cfg = dcfg
+                self._draft_params = dparams
         # ``mesh``: run the serving hot loop sharded — slots (the decode
         # batch axis) and the page pool's page axis over ``data``, kv
         # heads over ``model``, params via ``shard_params`` (tp over
@@ -439,6 +544,19 @@ class ContinuousBatcher:
         )
         if mesh is not None:
             self.cache = jax.device_put(self.cache, self._pool_sharding)
+        if self._draft_cfg is not None:
+            # The draft pool: same n_pages/page_size/table geometry as
+            # the target's, its own [L_d, n, page, Hkv_d, D_d] planes.
+            # page_table/length are maintained in LOCKSTEP with the
+            # target cache at every install/release/assign site, so one
+            # host allocator serves both pools.
+            self.draft_cache = PagedKVCache.create(
+                self._draft_cfg,
+                c.n_pages,
+                c.page_size,
+                c.max_slots,
+                c.pages_per_seq,
+            )
         # Host-side refcounted page allocator; page 0 is the NULL page.
         # On a mesh, one pool (and one prefix registry) per data shard:
         # slot s (slots shard in contiguous blocks) draws only from its
@@ -521,7 +639,9 @@ class ContinuousBatcher:
         # gateway_device_programs_total / gateway_ragged_rows_per_program
         # — and the count of loop iterations that ran any program (the
         # denominator of "device programs per scheduler iteration").
-        self._programs = {"fused": 0, "decode": 0, "prefill": 0}
+        self._programs = {
+            "fused": 0, "decode": 0, "prefill": 0, "spec": 0, "draft": 0,
+        }
         self._ragged_rows_sum = 0
         self._ragged_rows_count = 0
         self._work_iterations = 0
@@ -580,6 +700,29 @@ class ContinuousBatcher:
         self._jit_copy_page = jax.jit(copy_page, donate_argnums=(0,))
         self._jit_install_page = jax.jit(install_page, donate_argnums=(0,))
         self._jit_unembed = jax.jit(partial(unembed_one, self.cfg))
+        # Speculative state (PR 9). _spec_cfg pins the MoE dispatch of
+        # the k+1-token verify rows to the plain decode step's choice,
+        # exactly as engine/speculative.py pins its verify chunk.
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_shared_rows = 0
+        self._spec_acc_sum = 0.0
+        self._spec_acc_count = 0
+        self._spec_verified_last = 0
+        if self._draft_cfg is not None:
+            self._spec_cfg = cfg.moe_pin_for(
+                c.max_slots, c.max_slots * (c.spec_k + 1)
+            )
+            self._jit_spec = jax.jit(
+                self._spec_sample,
+                static_argnums=(0, 11, 12),
+                donate_argnums=(3, 4),
+            )
+            self._jit_chunk_d = {}  # (chunk, s_bucket) -> draft chunk
+            self._jit_prefill_d = {}  # s_bucket -> draft dense prefill
+            # Draft-pool copy/install ride _jit_copy_page /
+            # _jit_install_page: jit caches per input shape, so the
+            # draft planes just add a second cached trace.
         # Round-robin pointer over prefilling slots (fairness when
         # several prompts fill concurrently).
         self._prefill_rr = 0
@@ -603,6 +746,33 @@ class ContinuousBatcher:
         iteration, so a depth change between bursts takes effect
         without restarting the batcher (the bench's A/B lever)."""
         return max(1, self.config.pipeline_depth)
+
+    @property
+    def _spec_ok(self) -> bool:
+        """Whether decode rounds run the speculative draft/verify
+        program (PR 9). Read per loop iteration — ``spec_decode`` is
+        the bench's A/B lever. Needs steps_per_sync == 1: the verify
+        round IS the multi-token step, and folding further decode
+        steps into the same program would need a second data-dependent
+        scan (not worth the trace)."""
+        return (
+            self._draft_cfg is not None
+            and self.config.spec_k > 0
+            and self.config.spec_decode
+            and self._sync_chunk == 1
+        )
+
+    @property
+    def _round_tokens(self) -> int:
+        """Worst-case tokens ONE dispatched program advances a row by —
+        the page-overshoot unit. Plain decode: the steps_per_sync
+        chunk. With a draft configured: spec_k + 1 verify tokens,
+        counted REGARDLESS of the live spec_decode flip so in-flight
+        admissions stay budgeted across a flip."""
+        rt = self._sync_chunk
+        if self._draft_cfg is not None:
+            rt = max(rt, self.config.spec_k + 1)
+        return rt
 
     # -- device programs ------------------------------------------------
 
@@ -742,6 +912,201 @@ class ContinuousBatcher:
             return toks, logps, cache, tok_end, chunk_logits
         return tok1[:, None], logp1[:, None], cache, tok1, chunk_logits
 
+    def _spec_sample(
+        self,
+        spec_k,
+        params,
+        dparams,
+        cache,
+        dcache,
+        tokens,
+        seeds,
+        counts,
+        temps,
+        topks,
+        topps,
+        filters_active,
+        all_greedy,
+        groups,
+        draft_src,
+        spec_fill,
+        spec_off,
+    ):
+        """One speculative round — draft, verify, accept — as ONE
+        device program (PR 9).
+
+        tokens: [B] each row's newest committed token (its K/V not yet
+        written — the same invariant as the plain decode step's input);
+        counts: [B] device-resident per-row PRNG indices (data-
+        dependent under accept/rollback, so they thread program-to-
+        program like the cache instead of advancing on the host at
+        dispatch). Shared draft streams: ``draft_src`` [B] is each
+        row's stream donor (its own index = independent); a mate at
+        ``spec_off[i]`` tokens behind its donor takes its first
+        ``spec_off`` proposals from ``spec_fill`` [B, K] (the donor's
+        already-committed suffix — host-known, certain-accept while
+        the mate keeps agreeing) and the rest from the donor's fresh
+        proposals, and its draft-cache writes consume exactly that
+        stream, so its draft context stays consistent with what gets
+        verified.
+
+        The draft runs spec_k + 1 greedy steps (the +1 writes the last
+        proposal's K/V — on full acceptance the bonus token's next
+        round needs it; its own proposal is discarded, exactly like
+        ``speculative_generate``'s extra step). The target verifies
+        through :func:`verify_step_paged`'s ragged rows; the accept
+        rule is :func:`llm_consensus_tpu.engine.accept.verify_tokens`
+        — greedy rows byte-identical to plain decode, sampled rows the
+        exact one-hot residual rule. Both caches' ``length`` rewinds
+        to ``old + emit_cnt`` (count bookkeeping is the WHOLE
+        rollback: decode rows write only private pages, so a rejected
+        tail never touches registered/shared pages and simply gets
+        overwritten).
+
+        Returns (emit [B, K+1], emit_cnt [B], cache, dcache, next_in
+        [B], counts_out [B]).
+        """
+        k = spec_k
+        b = tokens.shape[0]
+        dcfg = self._draft_cfg
+
+        def dbody(carry, j):
+            dc, tok, hist = carry
+            lg, dc = decode_step_paged(dcfg, dparams, tok[:, None], dc)
+            prop = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B]
+            hist = hist.at[:, j].set(prop)
+            # Next input = each row's stream token j: donor committed
+            # fill while j < spec_off, else the donor's proposal
+            # j - spec_off (already in hist — a mate only ever lags).
+            from_donor = jnp.take_along_axis(
+                hist[draft_src], jnp.clip(j - spec_off, 0, k)[:, None], axis=1
+            )[:, 0]
+            nxt = jnp.where(
+                j < spec_off,
+                spec_fill[:, jnp.minimum(j, k - 1)],
+                from_donor,
+            )
+            return (dc, nxt, hist), None
+
+        hist0 = jnp.zeros((b, k + 1), jnp.int32)
+        (dcache, _, hist), _ = jax.lax.scan(
+            dbody, (dcache, tokens, hist0), jnp.arange(k + 1)
+        )
+        j_idx = jnp.arange(k)[None, :]
+        from_donor = jnp.take_along_axis(
+            hist[draft_src],
+            jnp.clip(j_idx - spec_off[:, None], 0, k),
+            axis=1,
+        )
+        drafts = jnp.where(
+            j_idx < spec_off[:, None], spec_fill, from_donor
+        )  # [B, K] each row's verified proposals == its draft-fed stream
+
+        vtok = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        logits, cache = verify_step_paged(
+            self._spec_cfg, params, vtok, cache, groups=groups
+        )  # [B, K+1, V] fp32
+
+        def row_keys(s, c):
+            base = jax.random.PRNGKey(s)
+            # Key j = the (seed, output-index) fold the plain sampler
+            # burns for generated token counts + j: per-request streams
+            # stay (seed, index)-addressed regardless of speculation.
+            return jax.vmap(lambda j: jax.random.fold_in(base, c + j))(
+                jnp.arange(k + 1)
+            )
+
+        keys = jax.vmap(row_keys)(seeds, counts)
+        # all_greedy STATIC: the per-position PRNG folds above become
+        # dead code on the greedy trace and jit erases them with the
+        # leviathan machinery.
+        emit, emit_cnt = verify_tokens(
+            logits, drafts, temps, topks, topps, keys,
+            filters_active=filters_active, all_greedy=all_greedy,
+        )
+        new_len = cache.length + emit_cnt
+        cache = PagedKVCache(
+            k=cache.k, v=cache.v, page_table=cache.page_table, length=new_len
+        )
+        # Draft-length invariant: committed - 1 == the target's length,
+        # for every row alike (the draft's next round re-consumes the
+        # newest committed token at that position).
+        dcache = PagedKVCache(
+            k=dcache.k,
+            v=dcache.v,
+            page_table=dcache.page_table,
+            length=new_len,
+        )
+        next_in = jnp.take_along_axis(
+            emit, (emit_cnt - 1)[:, None], axis=1
+        )[:, 0]
+        return emit, emit_cnt, cache, dcache, next_in, counts + emit_cnt
+
+    def _spec_stream_plan(self, rows_now):
+        """Host-side shared-draft-stream planning for one round.
+
+        Per shared-prefix bucket (GroupTracker first-page buckets — the
+        panel over one header), the member with the LONGEST committed
+        text is the donor; every mate whose generated tokens are a
+        prefix of the donor's rides the donor's stream (src -> donor,
+        fill = the donor's committed suffix, off = how far behind).
+        A mate that has diverged — different token anywhere — simply
+        stays its own stream; the comparison re-runs per round, so
+        divergence needs no sticky state and a retired donor just
+        stops being chosen. Returns (src [S], fill [S, K], off [S],
+        streams, shared_rows).
+
+        Pipeline staleness rule: with a spec program still in flight
+        (depth >= 2), ``generated`` lags the device by that program's
+        data-dependent emissions, so a donor-suffix FILL (off > 0)
+        built from the mirror would verify at shifted device positions
+        and mostly reject — worse than the mate drafting for itself.
+        The lagging-mate catch-up therefore only plans over an empty
+        pipeline window (depth 1, or right after a flush). The off ==
+        0 path stays allowed in flight: equal mirrors + one shared
+        greedy stream emit identically on device, so live equality is
+        preserved (a sampled mate can diverge invisibly for one round
+        and re-drafts alone the moment the mirror syncs — rejects for
+        a round, never wrong output).
+        """
+        c = self.config
+        k = c.spec_k
+        n = c.max_slots
+        src = np.arange(n, dtype=np.int32)
+        off = np.zeros((n,), np.int32)
+        fill = np.zeros((n, k), np.int32)
+        decoding = {i for i, _ in rows_now}
+        mirror_authoritative = not self._inflight
+        shared = 0
+        if c.share_prefix:
+            for bucket in self._groups.stream_buckets():
+                members = [i for i in bucket if i in decoding]
+                if len(members) < 2:
+                    continue
+                donor = max(
+                    members,
+                    key=lambda i: (len(self._slots[i].generated), -i),
+                )
+                dgen = self._slots[donor].generated
+                for i in members:
+                    if i == donor:
+                        continue
+                    gen = self._slots[i].generated
+                    m = len(gen)
+                    if gen != dgen[:m]:
+                        continue  # diverged from the donor's stream
+                    delta = len(dgen) - m
+                    if delta > 0 and not mirror_authoritative:
+                        continue  # stale fill — see staleness rule
+                    src[i] = donor
+                    off[i] = min(delta, k)
+                    tail = dgen[m : m + k]
+                    if tail:
+                        fill[i, : len(tail)] = tail
+                    shared += 1
+        streams = len({int(src[i]) for i in decoding})
+        return src, fill, off, streams, shared
+
     def _prefill_fn(self, s_bucket: int):
         """Jitted per-bucket: prefill one prompt densely, scatter to pages.
 
@@ -781,6 +1146,124 @@ class ContinuousBatcher:
                 partial(prefill_chunk_paged, cfg), donate_argnums=(4,)
             )
         return self._jit_chunk[key]
+
+    def _chunk_fn_d(self, chunk: int, s_bucket: int):
+        """Jitted per (chunk, prompt-bucket): the DRAFT model's paged
+        prefill chunk — same tokens/table/start as the target's chunk,
+        its own pool. Runs whenever a draft is configured (even with
+        spec_decode flipped off) so every admitted prompt has draft
+        context by the time speculation engages."""
+        key = (chunk, s_bucket)
+        if key not in self._jit_chunk_d:
+            dcfg = self._draft_cfg.moe_pin_for(s_bucket, chunk)
+            self._jit_chunk_d[key] = jax.jit(
+                partial(prefill_chunk_paged, dcfg), donate_argnums=(4,)
+            )
+        return self._jit_chunk_d[key]
+
+    def _prefill_fn_d(self, s_bucket: int):
+        """Jitted per-bucket DRAFT dense prefill (the legacy
+        ``prefill_chunk=0`` admission path's mirror)."""
+        if s_bucket not in self._jit_prefill_d:
+            dcfg = self._draft_cfg
+
+            def f(params, cache, tokens, length, seq_id):
+                dense = KVCache.create(dcfg, 1, s_bucket)
+                _, dense = prefill(dcfg, params, tokens, length[None], dense)
+                cache = write_prefill_kv(
+                    cache, seq_id, dense.k[:, 0], dense.v[:, 0], length
+                )
+                return cache
+
+            self._jit_prefill_d[s_bucket] = jax.jit(f, donate_argnums=(1,))
+        return self._jit_prefill_d[s_bucket]
+
+    def _draft_prefill_chunk(self, slot: _Slot, chunk_ids, pos: int) -> None:
+        """Run the draft's mirror of one prefill chunk (stream-ordered
+        behind whatever program carries the target's chunk)."""
+        self._count_program("draft")
+        _, self.draft_cache = self._chunk_fn_d(slot.chunk, slot.s_bucket)(
+            self._draft_params,
+            jnp.asarray(chunk_ids[None]),
+            jnp.asarray(slot.table),
+            jnp.int32(pos),
+            self.draft_cache,
+        )
+
+    def _spec_catch_up(self) -> None:
+        """Replay plain-decoded tokens through the draft before a spec
+        dispatch, for every row that decoded while ``spec_decode`` was
+        flipped off.
+
+        Plain decode programs advance only the target cache; the draft
+        mirror's length and K/V for the window's tokens go stale
+        (tracked per row in ``_Slot.draft_lag``). Without the replay
+        the next spec round's draft scan would write this row's K/V at
+        the stale positions — wrong RoPE, wrong span — and the row's
+        proposals would silently stop accepting for the rest of its
+        life. Greedy text stays correct either way (verify is exact);
+        what this protects is the speedup the flip is supposed to
+        resume.
+
+        The replay runs the draft's own chunk program over the missing
+        committed positions ``[tlen - lag, tlen)`` — all >= prompt_len,
+        so every write lands in the row's PRIVATE decode pages, never a
+        refcount-shared prefix page — in ``slot.chunk``-wide windows
+        (the admission traces, already compiled) plus width-1 steps for
+        the tail, then re-installs the row's draft length. A flip is a
+        between-bursts event; rows alive across one are the edge case.
+        """
+        lagging = [
+            (i, s)
+            for i, s in enumerate(self._slots)
+            if s is not None and s.phase == "decode" and s.draft_lag > 0
+        ]
+        if not lagging:
+            return
+        # Host mirror (generated tokens) must be current: drain any
+        # window the lag accumulated under.
+        if self._inflight:
+            self._flush_pipeline()
+            lagging = [
+                (i, s)
+                for i, s in lagging
+                if self._slots[i] is s and s.phase == "decode"
+            ]
+        for idx, slot in lagging:
+            # Newest committed token's K/V is pending in BOTH caches
+            # (the round input), so the draft must cover [dlen, tlen).
+            tlen = slot.prompt_len + len(slot.generated) - 1
+            dlen = tlen - slot.draft_lag
+            if slot.table is not None:
+                table = slot.table
+            else:
+                # Dense-admission rows: the table is the page list in
+                # positional order (mirrors _dense_prefill_pending).
+                table = np.full(
+                    (self.config.pages_per_seq,), NULL_PAGE, np.int32
+                )
+                table[: len(slot.pages)] = slot.pages
+            table_dev = jnp.asarray(table)
+            gen = np.asarray(slot.generated, np.int32)
+            cur = dlen
+            while cur < tlen:
+                width = slot.chunk if slot.chunk and tlen - cur >= slot.chunk else 1
+                toks = gen[cur - slot.prompt_len : cur - slot.prompt_len + width]
+                self._count_program("draft")
+                _, self.draft_cache = self._chunk_fn_d(width, slot.s_bucket)(
+                    self._draft_params,
+                    jnp.asarray(toks[None]),
+                    table_dev,
+                    jnp.int32(cur),
+                    self.draft_cache,
+                )
+                cur += width
+            # install_seq is idempotent on the (unchanged) table row;
+            # what this fixes is the row's draft length.
+            self.draft_cache = install_seq(
+                self.draft_cache, jnp.int32(idx), table_dev, jnp.int32(tlen)
+            )
+            slot.draft_lag = 0
 
     def _fused_fn(self, chunk: int, s_bucket: int):
         """Jitted per (chunk, prompt-bucket): the fused scheduler step
@@ -981,9 +1464,27 @@ class ContinuousBatcher:
                 "device_programs_fused": self._programs["fused"],
                 "device_programs_decode": self._programs["decode"],
                 "device_programs_prefill": self._programs["prefill"],
+                "device_programs_spec": self._programs["spec"],
+                "device_programs_draft": self._programs["draft"],
                 "ragged_rows_sum": self._ragged_rows_sum,
                 "ragged_rows_count": self._ragged_rows_count,
                 "work_iterations": self._work_iterations,
+                # Speculative decoding (PR 9) — the same observations
+                # behind gateway_spec_draft_tokens_total /
+                # gateway_spec_accepted_tokens_total /
+                # gateway_spec_acceptance / gateway_spec_verified_tokens
+                # (lockstep tested). drafted counts k per STREAM per
+                # round (one shared stream per agreeing panel group);
+                # shared_draft_rows counts row-rounds that reused a
+                # donor stream — per-sequence drafting would have
+                # drafted for those rows too, so this is the panel
+                # amortization realized.
+                "spec_draft_tokens": self._spec_drafted,
+                "spec_accepted_tokens": self._spec_accepted,
+                "spec_acceptance_sum": self._spec_acc_sum,
+                "spec_acceptance_count": self._spec_acc_count,
+                "spec_verified_tokens_last": self._spec_verified_last,
+                "spec_shared_draft_rows": self._spec_shared_rows,
             }
 
     def close(self) -> None:
@@ -1033,19 +1534,22 @@ class ContinuousBatcher:
         return self._table_pages(bucket, bucket, req)
 
     def _table_pages(self, bucket: int, prefill_end: int, req: _Request) -> int:
-        # + depth * steps_per_sync - 1: a row finishing mid-chunk keeps
+        # + depth * round_tokens - 1: a row finishing mid-chunk keeps
         # writing K/V until the decode-chunk boundary, and under
         # pipelined dispatch its retirement lags up to depth - 1 MORE
         # already-enqueued programs (all those tokens are discarded on
-        # host); its pages must absorb the full overshoot. depth 1,
-        # chunk 1 reduces this to the classic + 0.
+        # host); its pages must absorb the full overshoot. Under
+        # speculative decoding a round writes up to spec_k + 1 K/V
+        # positions of which a rejected tail is rewound — the same
+        # budget covers it (_round_tokens). depth 1, chunk 1, spec off
+        # reduces this to the classic + 0.
         # prefill_end: last position (+1) the chunked prefill may touch
         # — a shared-prefix start off the chunk grid can overhang the
         # bucket by up to chunk-1 positions of masked padding garbage.
         total = (
             max(bucket, prefill_end)
             + req.max_new_tokens
-            + self._depth * self._sync_chunk
+            + self._depth * self._round_tokens
             - 1
         )
         pg = self.config.page_size
@@ -1294,6 +1798,13 @@ class ContinuousBatcher:
         self.cache = self._jit_copy_page(
             self.cache, jnp.int32(src), jnp.int32(dst)
         )
+        if self.draft_cache is not None:
+            # The draft pool shares the page geometry: its boundary
+            # page carries the draft's K/V for the same tokens and
+            # must CoW with the target's.
+            self.draft_cache = self._jit_copy_page(
+                self.draft_cache, jnp.int32(src), jnp.int32(dst)
+            )
 
     def _flush_pipeline(self) -> None:
         """Drain every in-flight decode program (fetch + bookkeeping).
@@ -1341,18 +1852,23 @@ class ContinuousBatcher:
                 fetch.append((key, node.page))
         if fetch:
             pages = jnp.asarray([p for _, p in fetch], jnp.int32)
-            ks, vs = jax.device_get(
-                (self.cache.k[:, pages], self.cache.v[:, pages])
-            )  # [L, n, page, Hkv, Dh]
+            planes_dev = [self.cache.k[:, pages], self.cache.v[:, pages]]
+            if self.draft_cache is not None:
+                # Demote the draft pool's planes for the same pages in
+                # the SAME batched device_get: a restored prefix then
+                # comes back with its draft context (PR 9) — the store
+                # budget accounts all four planes' bytes.
+                planes_dev += [
+                    self.draft_cache.k[:, pages],
+                    self.draft_cache.v[:, pages],
+                ]
+            got = jax.device_get(tuple(planes_dev))  # [L, n, page, Hkv, Dh]
             for i, (key, _) in enumerate(fetch):
                 # Contiguous copies: a view into the batch buffer would
                 # pin the whole [L, n, ...] fetch alive in the store.
                 store.put(
                     key,
-                    (
-                        np.ascontiguousarray(ks[:, i]),
-                        np.ascontiguousarray(vs[:, i]),
-                    ),
+                    tuple(np.ascontiguousarray(pl[:, i]) for pl in got),
                 )
         _M_OFF_DEMOTED.inc(store.demoted_pages - demoted0)
         _M_OFF_DROPPED.inc(store.dropped_pages - dropped0)
@@ -1382,6 +1898,16 @@ class ContinuousBatcher:
             jnp.asarray(planes[0]),
             jnp.asarray(planes[1]),
         )
+        if self.draft_cache is not None and len(planes) >= 4:
+            # Draft planes demoted alongside the target's (PR 9): the
+            # restored prefix keeps its draft context, so acceptance
+            # doesn't silently collapse after an eviction round trip.
+            self.draft_cache = self._jit_install_page(
+                self.draft_cache,
+                jnp.int32(node.page),
+                jnp.asarray(planes[2]),
+                jnp.asarray(planes[3]),
+            )
         # The install must COMPLETE before readers are released (same
         # contract as a prefill chunk's block) — and the histogram's
         # point is the true host->device promotion latency.
@@ -1456,6 +1982,8 @@ class ContinuousBatcher:
             jnp.int32(slot.next_pos),
             self.cache,
         )
+        if self.draft_cache is not None:
+            self._draft_prefill_chunk(slot, chunk_ids, slot.next_pos)
         written_end = slot.next_pos + slot.chunk
         done = written_end >= slot.prompt_len
         if done:
@@ -1493,8 +2021,22 @@ class ContinuousBatcher:
             jnp.asarray(slot.table),
             jnp.int32(slot.prompt_len),
         )
+        self._install_draft_seq(idx, slot)
         self._activate(idx, slot, first)
         return True
+
+    def _install_draft_seq(self, idx: int, slot: _Slot) -> None:
+        """Mirror a slot activation into the draft pool: same table,
+        same length — the draft's committed-minus-one invariant starts
+        in sync with the target's."""
+        if self.draft_cache is None:
+            return
+        self.draft_cache = install_seq(
+            self.draft_cache,
+            jnp.int32(idx),
+            jnp.asarray(slot.table),
+            jnp.int32(slot.prompt_len),
+        )
 
     def _sample_first(self, req: _Request, logits) -> int:
         """First generated token, sampled from prefill logits — the
@@ -1516,12 +2058,17 @@ class ContinuousBatcher:
         slot.generated.append(first)
         slot.phase = "decode"
         slot.deps = []
-        if self._group_decode:
+        if self._group_decode or self.draft_cache is not None:
             # The row's prompt-prefix page run (full pages only — the
             # boundary page takes decode writes and must stay suffix).
             # Same page ids across rows == same tokens (sharing happens
             # only through the registry), so the tracker groups rows by
             # common run prefix: the panel's donor AND its mappers.
+            # With a draft configured the tracker ALSO runs on
+            # non-Pallas backends: its first-page buckets are the
+            # shared-draft-stream candidate sets (the grouped KERNEL
+            # still engages only under _group_decode — arrays() is
+            # consulted only there).
             self._groups.add(
                 idx, slot.pages[: slot.prompt_len // self.config.page_size]
             )
@@ -1600,6 +2147,7 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         self._count_program("prefill")
         s_bucket = self._bucket(len(req.prompt_ids))
+        slot.s_bucket = s_bucket  # program-family key (draft catch-up)
         padded = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
         padded[0, : len(req.prompt_ids)] = req.prompt_ids
         table = np.full((c.pages_per_seq,), NULL_PAGE, np.int32)
@@ -1614,6 +2162,20 @@ class ContinuousBatcher:
             jnp.int32(len(req.prompt_ids)),
             jnp.int32(idx),
         )
+        if self.draft_cache is not None:
+            # Mirror the legacy dense admission into the draft pool:
+            # same table, the draft's own dense prefill + scatter.
+            self._count_program("draft")
+            self.draft_cache = assign_pages(
+                self.draft_cache, jnp.int32(idx), jnp.asarray(table)
+            )
+            self.draft_cache = self._prefill_fn_d(s_bucket)(
+                self._draft_params,
+                self.draft_cache,
+                jnp.asarray(padded),
+                jnp.int32(len(req.prompt_ids)),
+                jnp.int32(idx),
+            )
         first = self._sample_first(req, logits)
         jax.block_until_ready(self.cache.length)
         # The whole-prompt stall this path pays per admission — the
@@ -1652,6 +2214,8 @@ class ContinuousBatcher:
         # plain per-row walk — nothing left to dedup).
         self._groups.remove(idx)
         self.cache = release_seq(self.cache, jnp.int32(idx))
+        if self.draft_cache is not None:
+            self.draft_cache = release_seq(self.draft_cache, jnp.int32(idx))
         pool = self._pools[self._shard_of_slot[idx]]
         with self._lock:
             # Refcounted release: private pages return to the free
@@ -1681,7 +2245,9 @@ class ContinuousBatcher:
                 )
             )
 
-    def _dispatch(self, chunk_idx: int | None = None) -> None:
+    def _dispatch(
+        self, chunk_idx: int | None = None, spec: bool = False
+    ) -> None:
         """Enqueue ONE decode program for the current decode batch.
 
         In pipelined mode (``pipeline_depth > 1``) this runs BEFORE the
@@ -1701,6 +2267,15 @@ class ContinuousBatcher:
         flush-first host operation — while its host bookkeeping
         (activation, first-token sampling off the returned logits)
         happens at the fetch, inside the pipeline's overlap window.
+
+        ``spec`` (PR 9): dispatch the speculative draft/verify program
+        instead — one device program whose per-row token yield is
+        data-dependent (accepted drafts + 1). It rides the SAME
+        pipeline: the emit buffer is the fetch target, the last
+        emitted token the next dispatch's input, and the PRNG counts
+        thread device-resident program-to-program (the host mirror
+        syncs at fetch). Mutually exclusive with ``chunk_idx`` —
+        chunks run standalone while speculation is engaged.
         """
         c = self.config
         k = self._sync_chunk
@@ -1749,19 +2324,84 @@ class ContinuousBatcher:
                 self._sched_overhead_sum += overhead
                 self._sched_overhead_count += 1
         self._last_step_end = None
+        # Snapshot rule as rows(): _tok_dirty is reset and _last_tokens
+        # mutated right after this dispatch; the spec branch reuses the
+        # same snapshot for its counts patch.
+        dirty_np = np.array(self._tok_dirty)
         if self._inflight:
             tokens = self._inflight[-1].next_input
-            if self._tok_dirty.any():
-                # Same snapshot rule as rows(): _tok_dirty is reset and
-                # _last_tokens mutated right after this dispatch.
+            if dirty_np.any():
                 tokens = jnp.where(
-                    jnp.asarray(np.array(self._tok_dirty)),
+                    jnp.asarray(dirty_np),
                     jnp.asarray(np.array(self._last_tokens)),
                     tokens,
                 )
         else:
             tokens = rows(self._last_tokens)
         self._tok_dirty[:] = False
+        if spec:
+            # Device-resident PRNG counts: the previous spec program's
+            # counts_out (data-dependent — the host can't advance them
+            # at dispatch), with (re)activated rows patched from the
+            # host mirror exactly like their input token. A mode flip
+            # drains the pipeline first (_run), so a spec window only
+            # ever chains spec outputs.
+            if self._inflight:
+                counts_dev = self._inflight[-1].counts_out
+                if dirty_np.any():
+                    counts_dev = jnp.where(
+                        jnp.asarray(dirty_np),
+                        jnp.asarray(np.array(self._counts)),
+                        counts_dev,
+                    )
+            else:
+                counts_dev = rows(self._counts)
+            src, fill, off, streams, shared = self._spec_stream_plan(
+                rows_now
+            )
+            emit, emit_cnt, self.cache, self.draft_cache, next_in, cnt_out = (
+                self._jit_spec(
+                    c.spec_k,
+                    self.params,
+                    self._draft_params,
+                    self.cache,
+                    self.draft_cache,
+                    tokens,
+                    rows(self._seeds),
+                    counts_dev,
+                    rows(temps),
+                    rows(self._topks),
+                    rows(self._topps),
+                    filters_active,
+                    all(
+                        s.request.temperature <= 0.0 for _, s in rows_now
+                    ),
+                    groups,
+                    rows(src),
+                    rows(fill),
+                    rows(off),
+                )
+            )
+            self._count_program("spec", rows=len(rows_now))
+            drafted = c.spec_k * streams
+            _M_SPEC_DRAFTED.inc(drafted)
+            with self._lock:
+                self._spec_drafted += drafted
+                self._spec_shared_rows += shared
+            # Host counts do NOT advance here (the plain path's += k):
+            # the yield is data-dependent; _fetch_one syncs the mirror.
+            rec = _Inflight(
+                tokens=emit,
+                next_input=next_in,
+                t0=t0,
+                k=1,
+                rows=rows_now,
+                spec=True,
+                spec_k=c.spec_k,
+                emit_cnt=emit_cnt,
+                counts_out=cnt_out,
+            )
+            return self._dispatch_tail(rec, groups, k)
         args = (
             self.params,
             self.cache,
@@ -1796,6 +2436,12 @@ class ContinuousBatcher:
                 chunk_done,
             )
             self._count_program("fused", rows=len(rows_now) + 1)
+            if self.draft_cache is not None:
+                # The draft's mirror of the riding chunk — its own
+                # small program right behind the fused dispatch (the
+                # two touch disjoint pools; stream order is irrelevant
+                # between them, only their fetch/flush consumers care).
+                self._draft_prefill_chunk(slot, chunk_ids, slot.next_pos)
             written_real = min(written_end, slot.prompt_len)
             # Device-stream readiness: the pages this chunk covers are
             # written by an ALREADY-DISPATCHED program, and every
@@ -1818,15 +2464,27 @@ class ContinuousBatcher:
         # Host counters track the DEVICE stream at dispatch: the
         # program advances every participating row by k regardless of
         # what the fetch later keeps, so a surviving row's next
-        # dispatch folds the right PRNG indices.
-        for i, _ in rows_now:
+        # dispatch folds the right PRNG indices. With a draft
+        # configured, a plain program also widens the row's draft lag
+        # (the mirror never saw these tokens — _spec_catch_up replays
+        # them when speculation re-engages).
+        for i, s in rows_now:
             self._counts[i] += k
-        self._inflight.append(
-            _Inflight(
-                tokens=next_tok, next_input=next_in, t0=t0, k=k,
-                rows=rows_now, chunk=chunk_rec,
-            )
+            if self.draft_cache is not None:
+                s.draft_lag += k
+        rec = _Inflight(
+            tokens=next_tok, next_input=next_in, t0=t0, k=k,
+            rows=rows_now, chunk=chunk_rec,
         )
+        self._dispatch_tail(rec, groups, k)
+
+    def _dispatch_tail(self, rec: "_Inflight", groups, k: int) -> None:
+        """Enqueue the dispatched program and account the window —
+        shared by the spec and plain branches so the bookkeeping
+        cannot drift. ``k`` is the steps this program reads the shared
+        prefix (spec programs pass 1: _spec_ok pins steps_per_sync to
+        1, and the verify round reads the group's shared pages once)."""
+        self._inflight.append(rec)
         _M_DISPATCH_INFLIGHT.set(len(self._inflight))
         _M_GROUP_SIZE.set(
             self._groups.largest_group if groups is not None else 0
@@ -1856,6 +2514,7 @@ class ContinuousBatcher:
         """
         rec = self._inflight.popleft()
         next_np = np.asarray(rec.tokens)  # [slots, k] — THE host sync
+        cnt_np = np.asarray(rec.emit_cnt) if rec.spec else None
         step_end = time.perf_counter()
         # Device-step latency: at depth 1 the program started at its
         # own dispatch; deeper, it started when its predecessor
@@ -1895,9 +2554,34 @@ class ContinuousBatcher:
         _M_STEPS.inc(rec.k)
         if rec.rows:
             _M_OCCUPANCY.observe(len(rec.rows))
+        if rec.spec:
+            # Sync the host PRNG-count mirror (the spec program's yield
+            # is data-dependent, so dispatch couldn't advance it), and
+            # feed the speculation metrics from one site. Rows whose
+            # slot was retired/reused mid-flight are skipped exactly
+            # like their tokens; a reused slot's activation reset its
+            # count and marked it dirty, so the mirror stays right.
+            emitted = 0
+            accepted = 0
+            for i, _ in alive:
+                n = int(cnt_np[i])
+                self._counts[i] += n
+                emitted += n
+                accepted += n - 1
+            if alive:
+                _M_SPEC_ACCEPTED.inc(accepted)
+                frac = accepted / (rec.spec_k * len(alive))
+                _M_SPEC_ACCEPTANCE.observe(frac)
+                _M_SPEC_VERIFIED.set(emitted)
+                with self._lock:
+                    self._spec_accepted += accepted
+                    self._spec_acc_sum += frac
+                    self._spec_acc_count += 1
+                    self._spec_verified_last = emitted
         for i, slot in alive:
             done = False
-            for j in range(rec.k):
+            n_emit = int(cnt_np[i]) if rec.spec else rec.k
+            for j in range(n_emit):
                 tok = int(next_np[i, j])
                 slot.generated.append(tok)
                 self._last_tokens[i] = tok
@@ -1942,6 +2626,7 @@ class ContinuousBatcher:
                     jnp.asarray(slot.table),
                     jnp.int32(slot.prompt_len),
                 )
+                self._install_draft_seq(ch.idx, slot)
                 self._activate(ch.idx, slot, first)
 
     def _run(self) -> None:
@@ -1960,13 +2645,22 @@ class ContinuousBatcher:
                     progress = True
                 else:
                     chunk_idx = self._pick_prefill_slot()
+            # Speculative decoding (PR 9): read the engage state once
+            # per iteration (the bench flips config.spec_decode between
+            # bursts). While speculation is on, chunks run standalone —
+            # the verify program IS the decode dispatch, and a chunk
+            # lane on it is future work.
+            spec_now = self._spec_ok
             # The fused scheduler step (PR 8): a ready chunk rides the
             # decode dispatch as one more ragged-kernel row — ONE
             # device program per iteration instead of chunk-then-
             # decode. With no decode batch to ride (or fusion off) the
             # chunk runs standalone, still one program this iteration.
             fused = (
-                chunk_idx is not None and self._fused_ok and self._decoding()
+                chunk_idx is not None
+                and self._fused_ok
+                and self._decoding()
+                and not spec_now
             )
             if chunk_idx is not None and not fused:
                 self._prefill_step(chunk_idx)
@@ -1990,7 +2684,19 @@ class ContinuousBatcher:
                 # run. depth 1 reduces to dispatch -> fetch -> bookkeep
                 # (the serialized parity baseline); the while also
                 # drains excess depth after a live depth reduction.
-                self._dispatch(chunk_idx if fused else None)
+                if self._inflight and self._inflight[-1].spec != spec_now:
+                    # A plain program feeds the next dispatch from
+                    # host-advanced counts; a spec program from its
+                    # device counts_out. Mixing the two in one window
+                    # would desync the PRNG mirror — drain first (a
+                    # flip is a between-bursts event, never hot-path).
+                    self._flush_pipeline()
+                if spec_now:
+                    # Rows that decoded through an off window need
+                    # their draft mirror replayed first — no-op in the
+                    # steady state (every lag-free iteration).
+                    self._spec_catch_up()
+                self._dispatch(chunk_idx if fused else None, spec=spec_now)
                 while len(self._inflight) >= self._depth:
                     self._fetch_one()
                 progress = True
