@@ -52,11 +52,111 @@ def _emit(payload: dict, out: str | None) -> None:
     """The ONE result sink every leg shares: the JSON line goes to
     stdout (the historical contract scripts tail) and — with ``--out``
     — atomically to the artifact path, so driver scripts stop relying
-    on shell redirection that can tear."""
+    on shell redirection that can tear.
+
+    Every payload carries a machine-readable ``status`` ("ok" unless
+    the leg set one — the chip-unreachable path emits
+    "chip-unreachable"), so history tooling
+    (scripts/bench_history.py) stops string-matching the metric name
+    to tell a measurement from a no-data round.
+    """
+    payload = dict(payload)
+    payload.setdefault("status", "ok")
     line = json.dumps(payload)
     print(line, flush=True)
     if out:
         _atomic_write_text(out, line + "\n")
+
+
+def _load_average() -> float | None:
+    """1-minute loadavg (None where the platform lacks it)."""
+    try:
+        return os.getloadavg()[0]
+    except (OSError, AttributeError):
+        return None
+
+
+def _box_contended() -> tuple[float | None, bool]:
+    """Detect co-running load on the box. The bench itself keeps
+    ~1 runnable thread (batcher worker) busy, so a 1-min loadavg past
+    cpu_count + 1 means someone else is competing for the cores — the
+    exact condition under which the PR-5 trace-overhead gate flaked
+    during the PR-9 run (a concurrently-running bench). Used to size
+    the overhead legs' escalation budget, not to skip the gate."""
+    la = _load_average()
+    return la, la is not None and la > (os.cpu_count() or 1) + 1.0
+
+
+def _paired_overhead_pct(offs: list[float], ons: list[float]) -> float:
+    """Median paired on-vs-off overhead in percent. Rounds alternate
+    off/on, so pairing cancels the common-mode drift of a shared box
+    (GC, other tenants); the MEDIAN pair is robust to one jittered
+    round. A real instrumentation regression is in EVERY pair."""
+    from statistics import median
+
+    return 100.0 * median(1.0 - on / off for off, on in zip(offs, ons))
+
+
+def _dual_gate_ok(
+    offs: list[float], ons: list[float], pct: float = 2.0
+) -> bool:
+    """The PR-5 dual overhead gate: best-vs-best (bests approach the
+    box's clean-run ceiling, so a TRUE overhead shifts them) OR the
+    paired median. Smoke-size legs are ~fractions of a second on a
+    shared 1-core box, where single hiccups swing one estimator by
+    tens of percent — a real >= pct% regression moves BOTH, noise
+    rarely moves both the same way."""
+    return (
+        max(ons) >= (1.0 - pct / 100.0) * max(offs)
+        or _paired_overhead_pct(offs, ons) <= pct
+    )
+
+
+def _ab_rounds(leg, rounds: int) -> tuple[list[float], list[float]]:
+    """The overhead legs' alternating off/on measurement rounds —
+    within-pair order alternates so "runs second" (page cache, GC
+    timing) is not systematically the on-leg. ONE copy for every
+    overhead A/B (trace, flight); returns (runs_off, runs_on)."""
+    runs_off: list[float] = []
+    runs_on: list[float] = []
+    for r in range(max(1, rounds)):
+        if r % 2 == 0:
+            runs_off.append(leg(f"off{r}", False))
+            runs_on.append(leg(f"on{r}", True))
+        else:
+            runs_on.append(leg(f"on{r}", True))
+            runs_off.append(leg(f"off{r}", False))
+    return runs_off, runs_on
+
+
+def _ab_escalate(leg, runs_off, runs_on, tag: str) -> None:
+    """Escalate alternating off/on pairs until the dual gate passes or
+    the budget runs out (the caller re-checks the gate for the final
+    verdict). Budget: 3 extra pairs on a quiet box, 6 when the loadavg
+    guard detects co-running load — box contention is the documented
+    cause of the PR-9 flake, and buying more pairs under it beats
+    failing on the first noisy one (a REAL regression fails all 6+)."""
+    extra = 0
+    while not _dual_gate_ok(runs_off, runs_on):
+        la, contended = _box_contended()
+        budget = 6 if contended else 3
+        if extra >= budget:
+            return
+        extra += 1
+        print(
+            f"[bench] {tag}: paired overhead "
+            f"{_paired_overhead_pct(runs_off, runs_on):.2f}% and best "
+            f"ratio {max(runs_on) / max(runs_off):.4f} both fail "
+            f"(loadavg {la if la is None else round(la, 2)}, "
+            f"contended={contended}); extra round {extra}/{budget}",
+            file=sys.stderr,
+        )
+        if extra % 2 == 0:
+            runs_off.append(leg(f"off-x{extra}", False))
+            runs_on.append(leg(f"on-x{extra}", True))
+        else:
+            runs_on.append(leg(f"on-x{extra}", True))
+            runs_off.append(leg(f"off-x{extra}", False))
 
 
 # The ONE probe body, run both in-process (_chip_responsive, via exec)
@@ -386,6 +486,24 @@ def main() -> int:
         "compares per-leg bests)",
     )
     p.add_argument(
+        "--serve-flight-overhead",
+        action="store_true",
+        help="observability A/B leg (PR 10): the identical "
+        "panel-shaped burst served with the serving flight recorder "
+        "ON (typed scheduler events, program windows, per-request "
+        "token timelines at /debug/flight) vs OFF — the PR-5 dual "
+        "tok/s gate (per-leg bests within 2%% OR paired-median <= "
+        "2%%, loadavg-aware escalation) proves the recorder is free "
+        "when sampling",
+    )
+    p.add_argument(
+        "--flight-ab-rounds",
+        type=int,
+        default=2,
+        help="alternating off/on measurement rounds for "
+        "--serve-flight-overhead",
+    )
+    p.add_argument(
         "--out",
         default="",
         help="also write the final JSON line to this path ATOMICALLY "
@@ -454,6 +572,9 @@ def main() -> int:
                 "value": 0.0,
                 "unit": "tokens/sec/chip",
                 "vs_baseline": 0.0,
+                # Machine-readable: a no-data round, NOT a 0-tok/s
+                # measurement (bench_history treats it as such).
+                "status": "chip-unreachable",
             },
             args.out,
         )
@@ -539,6 +660,8 @@ def main() -> int:
         return _bench_serving_ragged_ab(args, cfg, params)
     if args.serve_trace_overhead:
         return _bench_serving_trace_overhead(args, cfg, params)
+    if args.serve_flight_overhead:
+        return _bench_serving_flight_overhead(args, cfg, params)
     if args.serve_offload:
         return _bench_serving_offload(args, cfg, params)
     if args.serve_prefix_attention:
@@ -1799,63 +1922,15 @@ def _bench_serving_trace_overhead(args, cfg, params) -> int:
         batcher.submit(
             header + "warmup tail", max_new_tokens=args.new_tokens
         ).result(timeout=600)
-        from statistics import median
-
-        def paired_overhead(offs, ons):
-            # Rounds alternate off/on, so pairing them cancels the
-            # common-mode drift of a shared box (GC, other tenants);
-            # the MEDIAN pair is robust to one jittered round. A real
-            # instrumentation regression is in EVERY pair.
-            return 100.0 * median(
-                1.0 - on / off for off, on in zip(offs, ons)
-            )
-
-        def gate_ok(offs, ons):
-            # Dual gate: best-vs-best (bests approach the box's clean-
-            # run ceiling, so a TRUE overhead shifts them) OR the
-            # paired median. Smoke-size legs are ~fractions of a
-            # second on a shared 1-core box, where single hiccups can
-            # swing one estimator by tens of percent — a real >= 2%
-            # regression moves BOTH, noise rarely moves both the same
-            # way.
-            return (
-                max(ons) >= 0.98 * max(offs)
-                or paired_overhead(offs, ons) <= 2.0
-            )
-
-        runs_off, runs_on = [], []
-        rounds = max(1, args.trace_ab_rounds)
-        for r in range(rounds):
-            # Alternate within-pair order so "runs second" (page
-            # cache, GC timing) is not systematically the on-leg.
-            if r % 2 == 0:
-                runs_off.append(leg(f"off{r}", False))
-                runs_on.append(leg(f"on{r}", True))
-            else:
-                runs_on.append(leg(f"on{r}", True))
-                runs_off.append(leg(f"off{r}", False))
-        # Escalate before failing: smoke-size runs jitter more than the
-        # 2% gate; extra pairs tighten both estimators.
-        extra = 0
-        while not gate_ok(runs_off, runs_on) and extra < 3:
-            extra += 1
-            print(
-                f"[bench] paired overhead "
-                f"{paired_overhead(runs_off, runs_on):.2f}% and best "
-                f"ratio {max(runs_on) / max(runs_off):.4f} both fail; "
-                f"extra round {extra}",
-                file=sys.stderr,
-            )
-            if extra % 2 == 0:
-                runs_off.append(leg(f"off-x{extra}", False))
-                runs_on.append(leg(f"on-x{extra}", True))
-            else:
-                runs_on.append(leg(f"on-x{extra}", True))
-                runs_off.append(leg(f"off-x{extra}", False))
+        runs_off, runs_on = _ab_rounds(leg, args.trace_ab_rounds)
+        # Escalate before failing: smoke-size runs jitter more than
+        # the 2% gate, and the loadavg guard buys extra pairs when
+        # co-running load is detected (the PR-9 flake's cause).
+        _ab_escalate(leg, runs_off, runs_on, "trace-overhead")
     finally:
         batcher.close()
     tps_off, tps_on = max(runs_off), max(runs_on)
-    overhead_pct = paired_overhead(runs_off, runs_on)
+    overhead_pct = _paired_overhead_pct(runs_off, runs_on)
     spans = span_counts[-1] if span_counts else 0
     _emit(
         {
@@ -1871,11 +1946,131 @@ def _bench_serving_trace_overhead(args, cfg, params) -> int:
         },
         args.out,
     )
-    if not gate_ok(runs_off, runs_on):
+    if not _dual_gate_ok(runs_off, runs_on):
         print(
             f"[bench] TRACING OVERHEAD {overhead_pct:.2f}% paired-median "
             f"AND best ratio {tps_on / tps_off:.4f} < 0.98 — "
             "instrumentation regression",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def _bench_serving_flight_overhead(args, cfg, params) -> int:
+    """Flight-recorder A/B (PR 10 acceptance): the identical
+    panel-shaped burst served with the flight recorder ON (typed
+    scheduler events — program windows, admissions, token timelines,
+    request summaries — at /debug/flight) vs OFF
+    (``flight.set_enabled(False)``), through ONE batcher with the
+    PR-5 dual tok/s gate. The recorder must be free when sampling:
+    per event it is one bool check + one lock+append, and per token
+    one perf_counter read — if this leg fails on a quiet box, an
+    instrumentation site regressed onto the hot path.
+    """
+    from llm_consensus_tpu.serving import flight as _flight
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    n = args.serve_requests
+    longest = header_target + 64
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], args.new_tokens, args.serve_chunk, pg
+    )
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2
+    batcher = ContinuousBatcher(
+        cfg,
+        params,
+        config=ContinuousConfig(
+            max_slots=args.serve_slots,
+            page_size=pg,
+            n_pages=n_pages,
+            pages_per_seq=pages_per_seq,
+            max_new_tokens=args.new_tokens,
+            seq_buckets=tuple(buckets),
+            steps_per_sync=args.serve_chunk,
+            prefill_chunk=args.serve_prefill_chunk or 64,
+            share_prefix=True,
+        ),
+    )
+
+    event_counts: list[int] = []
+    # ONE shared header for every leg (the trace-overhead leg's
+    # discipline): the registry reaches steady state in warmup so each
+    # leg does identical device work — the A/B isolates the recorder.
+    header = f"Panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+
+    def leg(tag: str, on: bool) -> float:
+        prompts = [
+            header + f"Q{i}-{tag}: item {i * 37 % 101}?" for i in range(n)
+        ]
+        # Fresh ring per leg: the A/B measures event RECORDING, and a
+        # ring already at capacity would tax later legs' evictions
+        # asymmetrically.
+        _flight.flight_recorder().clear()
+        _flight.set_enabled(on)
+        try:
+            t0 = time.perf_counter()
+            futs = [
+                batcher.submit(p, max_new_tokens=args.new_tokens)
+                for p in prompts
+            ]
+            toks = sum(f.result(timeout=600).num_tokens for f in futs)
+            wall = time.perf_counter() - t0
+        finally:
+            _flight.set_enabled(True)
+        if on:
+            event_counts.append(len(_flight.flight_recorder()))
+        return toks / wall
+
+    try:
+        batcher.submit(
+            header + "warmup tail", max_new_tokens=args.new_tokens
+        ).result(timeout=600)
+        runs_off, runs_on = _ab_rounds(leg, args.flight_ab_rounds)
+        _ab_escalate(leg, runs_off, runs_on, "flight-overhead")
+    finally:
+        batcher.close()
+    tps_off, tps_on = max(runs_off), max(runs_on)
+    overhead_pct = _paired_overhead_pct(runs_off, runs_on)
+    events = event_counts[-1] if event_counts else 0
+    _emit(
+        {
+            "metric": f"serving tok/s, flight recorder ON "
+            f"({cfg.name}, {max(1, args.flight_ab_rounds)}x{n} reqs, "
+            f"slots={args.serve_slots}, decode {args.new_tokens} @ "
+            f"~{header_target} shared prompt, recorder OFF "
+            f"{tps_off:.0f} tok/s, overhead {overhead_pct:+.2f}%, "
+            f"{events} events over the last on-leg burst)",
+            "value": round(tps_on, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(tps_on / max(tps_off, 1e-9), 4),
+        },
+        args.out,
+    )
+    if events <= 0:
+        print(
+            "[bench] flight leg recorded no events with the recorder "
+            "on — the A/B measured nothing",
+            file=sys.stderr,
+        )
+        return 1
+    if not _dual_gate_ok(runs_off, runs_on):
+        print(
+            f"[bench] FLIGHT-RECORDER OVERHEAD {overhead_pct:.2f}% "
+            f"paired-median AND best ratio "
+            f"{tps_on / tps_off:.4f} < 0.98 — instrumentation "
+            "regression",
             file=sys.stderr,
         )
         return 1

@@ -55,6 +55,13 @@ class GenerationResult:
     # Sum of log-probabilities of the sampled tokens, for logit-pooled
     # aggregation; None when unavailable.
     logprob: float | None = None
+    # Backend-specific serving metadata (PR 10): the continuous batcher
+    # attaches its per-request timing summary (TTFT, inter-token-gap
+    # percentiles, speculation tallies, header-page provenance) — the
+    # gateway surfaces it as the response's "meta". None when the
+    # backend records nothing. compare=False: result equality means
+    # "same generation", and timing stamps never repeat.
+    meta: dict | None = field(default=None, compare=False)
 
 
 class Backend(abc.ABC):

@@ -182,6 +182,7 @@ def _build_backend(args):
                 pipeline_depth=args.pipeline_depth,
                 ragged_attention=not args.no_ragged_attention,
                 spec_k=args.spec_k if draft is not None else 0,
+                hbm_gbps=args.hbm_gbps,
             ),
             mesh=mesh,
             draft=draft,
@@ -252,6 +253,17 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "the host loop enqueues program n+1 before fetching program "
         "n's tokens, hiding scheduling work behind device compute "
         "(1 = the serialized loop; outputs are identical either way)",
+    )
+    p.add_argument(
+        "--hbm-gbps",
+        type=float,
+        default=0.0,
+        help="continuous backend: the device's peak HBM bandwidth in "
+        "GB/s for roofline attribution — > 0 publishes "
+        "gateway_program_mbu{kind} (modeled program HBM bytes / "
+        "measured wall time / this peak; ~1.0 = at the weights+KV "
+        "roofline). 0 = gauge off; the modeled-bytes and measured-"
+        "seconds sums still accumulate in the batcher's stats()",
     )
     p.add_argument(
         "--cpu",
@@ -526,6 +538,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
         default=2048,
         help="span budget per trace (excess spans dropped + counted)",
     )
+    # Observability (PR 10): the serving flight recorder.
+    p.add_argument(
+        "--no-flight",
+        action="store_true",
+        help="disable the serving flight recorder (typed scheduler "
+        "events at GET /debug/flight incl. the Perfetto-loadable "
+        "?format=chrome export; default ON — bench.py "
+        "--serve-flight-overhead holds the cost under the PR-5 2%% "
+        "tok/s gate)",
+    )
+    p.add_argument(
+        "--flight-events",
+        type=int,
+        default=8192,
+        help="bounded flight-recorder ring: retained scheduler events "
+        "(evict-oldest; drops counted in gateway_flight_dropped_total)",
+    )
     p.add_argument(
         "--profile-dir",
         default=None,
@@ -563,6 +592,11 @@ def _run_serve(argv: list[str]) -> int:
     _tracing.trace_store().configure(
         max_traces=args.trace_max_traces, max_spans=args.trace_max_spans
     )
+    from llm_consensus_tpu.serving import flight as _flight
+
+    if args.no_flight:
+        _flight.set_enabled(False)
+    _flight.flight_recorder().configure(capacity=args.flight_events)
     panel = load_panel(args.panel) if args.panel else default_panel()
     backend = _build_backend(args)
     gateway = Gateway(

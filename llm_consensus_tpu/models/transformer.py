@@ -273,6 +273,83 @@ def param_count(params) -> int:
     return sum(p.size for p in jax.tree_util.tree_leaves(params))
 
 
+def model_param_bytes(params) -> tuple[int, int]:
+    """``(hbm_bytes, n_params)`` of a parameter tree as it sits in HBM.
+
+    The roofline cost model's weight term (PR 10): every decode-shaped
+    device program streams the whole tree once, so its byte size (at
+    the ACTUAL leaf dtypes — int8 quantized leaves count 1 byte + their
+    scales, not the bf16 they stand for) is the floor of the program's
+    HBM traffic. ``n_params`` (scales included — they are read too) is
+    the matmul-FLOPs multiplier :func:`program_hbm_cost` uses.
+    """
+    leaves = [
+        p for p in jax.tree_util.tree_leaves(params) if hasattr(p, "dtype")
+    ]
+    return (
+        int(sum(p.size * jnp.dtype(p.dtype).itemsize for p in leaves)),
+        int(sum(p.size for p in leaves)),
+    )
+
+
+def kv_plane_token_bytes(cfg: ModelConfig, kv_dtype) -> int:
+    """HBM bytes one token position costs per full K+V read/write across
+    all layers at the pool's dtype — the cost model's KV unit (and the
+    unit of ``gateway_shared_kv_bytes_saved_total``, same formula)."""
+    return (
+        cfg.n_layers
+        * cfg.n_kv_heads
+        * cfg.head_dim
+        * 2
+        * jnp.dtype(kv_dtype).itemsize
+    )
+
+
+def program_hbm_cost(
+    cfg: ModelConfig,
+    *,
+    weight_bytes: int,
+    weight_params: int,
+    kv_token_bytes: int,
+    kv_read_tokens: int,
+    kv_write_tokens: int,
+    tokens: int,
+) -> dict:
+    """Static HBM-bytes + FLOPs model for ONE device program (PR 10).
+
+    The decode roofline in the terms ClusterFusion++ and the
+    operation-fusion paper argue it (PAPERS.md): a program moves
+    ``weight_bytes`` (the whole tree, once — the term fusion amortizes
+    across rows and speculation amortizes across tokens) plus
+    ``(kv_read_tokens + kv_write_tokens) * kv_token_bytes`` of KV pages
+    it actually touches (group-shared prefix reads counted ONCE per
+    group — callers pass post-dedup token counts), and computes
+    ``2 * weight_params`` matmul FLOPs per processed token plus the
+    attention dot-products (4 * n_heads * head_dim per (query, kv)
+    pair). Measured wall time / (hbm_bytes / peak_bw) is the program's
+    model-bandwidth-utilization — ``gateway_program_mbu{kind}``.
+
+    A MODEL, not a measurement: activation traffic, index/table reads,
+    and padding rows are excluded; on a chip whose decode programs are
+    truly bandwidth-bound the modeled bytes are the dominant term and
+    MBU lands near 1.0.
+    """
+    hbm_bytes = int(
+        weight_bytes + (kv_read_tokens + kv_write_tokens) * kv_token_bytes
+    )
+    flops = int(
+        2 * weight_params * tokens
+        + 4 * cfg.n_heads * cfg.head_dim * kv_read_tokens
+    )
+    return {
+        "hbm_bytes": hbm_bytes,
+        "flops": flops,
+        "kv_read_tokens": int(kv_read_tokens),
+        "kv_write_tokens": int(kv_write_tokens),
+        "tokens": int(tokens),
+    }
+
+
 def init_params_quantized(
     cfg: ModelConfig,
     key: jax.Array,

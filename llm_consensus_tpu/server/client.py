@@ -109,6 +109,27 @@ class GatewayClient:
         path = "/debug/traces" + (f"?id={trace_id}" if trace_id else "")
         return self._json("GET", path)
 
+    def flight(self, format: str | None = None, limit: int | None = None) -> dict:
+        """``GET /debug/flight``: the serving flight recorder's event
+        ring; ``format="chrome"`` returns Chrome trace-event JSON
+        (save it and open in Perfetto / chrome://tracing)."""
+        q = []
+        if format:
+            q.append(f"format={format}")
+        if limit is not None:
+            q.append(f"limit={limit}")
+        return self._json(
+            "GET", "/debug/flight" + ("?" + "&".join(q) if q else "")
+        )
+
+    def requests(self, request_id: str | None = None) -> dict:
+        """``GET /debug/requests``: per-request serving summaries, or
+        one by request id / trace id."""
+        path = "/debug/requests" + (
+            f"?id={request_id}" if request_id else ""
+        )
+        return self._json("GET", path)
+
     def metrics(self) -> str:
         _, data = self._request("GET", "/metrics")
         return data.decode()

@@ -30,6 +30,17 @@ Routes:
 - ``GET /debug/traces`` — request-trace summaries (newest first);
   ``?id=<trace_id>`` returns one trace's full span tree. Every
   ``/v1/*`` response carries its ``trace_id`` (body + ``X-Trace-Id``).
+- ``GET /debug/flight`` — the serving flight recorder (PR 10): the
+  bounded ring of typed scheduler events as JSON, or with
+  ``?format=chrome`` as Chrome trace-event JSON loadable in Perfetto
+  (device track reconstructed from dispatch→fetch windows, host track
+  for un-overlapped scheduler work, one track per request).
+- ``GET /debug/requests`` — per-request serving summaries (TTFT,
+  inter-token-gap percentiles, spec tokens accepted per round,
+  restored-vs-prefilled header pages); ``?id=<request or trace id>``
+  returns one — or every member, for a trace several generations ran
+  under (a consensus panel fan-out). The same summary rides each
+  ``/v1/generate`` response as ``meta`` when the backend records one.
 
 Status mapping: 429 + ``Retry-After`` on shed, 503 + ``Retry-After``
 while draining, 504 on deadline expiry, 502 on backend failure, 400 on
@@ -383,6 +394,12 @@ class Gateway:
         if path == "/debug/traces" and method == "GET":
             await self._handle_traces(rawq, writer)
             return
+        if path == "/debug/flight" and method == "GET":
+            await self._handle_flight(rawq, writer)
+            return
+        if path == "/debug/requests" and method == "GET":
+            await self._handle_requests(rawq, writer)
+            return
         if path == "/metrics" and method == "GET":
             text = self.registry.render().encode()
             await self._respond_raw(
@@ -451,6 +468,128 @@ class Gateway:
             },
         )
         self._count("/debug/traces", 200)
+
+    async def _handle_flight(self, rawq: str, writer) -> None:
+        """``GET /debug/flight``: the flight recorder's event ring
+        (PR 10). ``?format=chrome`` renders Chrome trace-event JSON
+        (open in Perfetto / chrome://tracing); the plain JSON form
+        takes ``?limit=N`` (newest N events). Programs still in flight
+        appear with their dispatch stamp and zero duration — quiesce
+        before comparing the device track against counters."""
+        from urllib.parse import parse_qs
+
+        # Deferred: serving.flight rides the serving package (jax);
+        # a FakeBackend gateway only pays that import if someone asks.
+        from llm_consensus_tpu.serving import flight as _flight
+
+        q = parse_qs(rawq)
+        rec = _flight.flight_recorder()
+        events = rec.events()
+        raw_limit = (q.get("limit") or [None])[0]
+        limit = None
+        if raw_limit is not None:
+            try:
+                limit = int(raw_limit)
+            except ValueError:
+                limit = None
+        if (q.get("format") or [""])[0] == "chrome":
+            # ?limit= applies here too (newest N events); the default
+            # is the whole ring — a Perfetto export wants everything.
+            if limit is not None:
+                # limit <= 0 really means "no events" — a bare -0:
+                # slice would return the whole ring.
+                events = events[-limit:] if limit > 0 else []
+            await self._respond_json(writer, 200, _flight.to_chrome(events))
+            self._count("/debug/flight", 200)
+            return
+        if limit is None:
+            limit = 512
+        await self._respond_json(
+            writer,
+            200,
+            {
+                "enabled": _flight.enabled(),
+                "capacity": rec.capacity,
+                "dropped": rec.dropped,
+                "n_events": len(events),
+                "events": [
+                    e.to_dict()
+                    for e in (events[-limit:] if limit > 0 else [])
+                ],
+            },
+        )
+        self._count("/debug/flight", 200)
+
+    async def _handle_requests(self, rawq: str, writer) -> None:
+        """``GET /debug/requests``: per-request serving summaries from
+        the RequestLog (newest first); ``?id=`` accepts a request id
+        OR a trace id."""
+        from urllib.parse import parse_qs
+
+        from llm_consensus_tpu.serving import flight as _flight
+
+        q = parse_qs(rawq)
+        log_ = _flight.request_log()
+        rid = (q.get("id") or [None])[0]
+        if rid:
+            docs = log_.get_all(rid)
+            if not docs:
+                await self._respond_json(
+                    writer, 404, {"error": f"no request {rid!r}"}
+                )
+                self._count("/debug/requests", 404)
+                return
+            # One trace can cover several generations (a consensus
+            # panel fan-out): a unique match returns the summary doc
+            # itself, a shared trace returns every member.
+            await self._respond_json(
+                writer,
+                200,
+                docs[0]
+                if len(docs) == 1
+                else {"id": rid, "requests": docs},
+            )
+            self._count("/debug/requests", 200)
+            return
+        try:
+            limit = int((q.get("limit") or ["50"])[0])
+        except ValueError:
+            limit = 50
+        await self._respond_json(
+            writer,
+            200,
+            {
+                "retained": len(log_),
+                "requests": log_.recent(limit),
+            },
+        )
+        self._count("/debug/requests", 200)
+
+    def _record_shed(self, route: str, trace) -> None:
+        """Mirror an admission shed into the flight recorder (PR 10):
+        the timeline's counterpart of the 429/503 the client saw.
+
+        Records ONLY when the flight module is already loaded: an
+        import here would execute the serving package's __init__ (and
+        with it jax) synchronously inside the event loop — seconds of
+        stall for every in-flight request, at exactly peak overload.
+        A gateway whose backend never loaded the serving stack has no
+        batcher feeding the ring, so there is no timeline to join.
+        """
+        import sys as _sys
+
+        mod = _sys.modules.get("llm_consensus_tpu.serving.flight")
+        if mod is None:
+            return
+        try:
+            mod.flight_recorder().record(
+                "shed",
+                time.perf_counter(),
+                trace_id=_tracing.trace_id_of(trace),
+                route=route,
+            )
+        except Exception:  # noqa: BLE001 - recording must never 500
+            log.exception("flight shed record failed")
 
     # -- routes ---------------------------------------------------------
 
@@ -576,10 +715,10 @@ class Gateway:
                 )
         except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
             status, doc, hdrs = self._error_response(e)
-            if trace is not None and isinstance(
-                e, (QueueFullError, DrainingError)
-            ):
-                _tracing.trace_store().discard(trace.trace_id)
+            if isinstance(e, (QueueFullError, DrainingError)):
+                self._record_shed("/v1/generate", trace)
+                if trace is not None:
+                    _tracing.trace_store().discard(trace.trace_id)
             await self._respond_json(writer, status, doc, hdrs)
             self._count("/v1/generate", status)
             return
@@ -589,6 +728,7 @@ class Gateway:
         dt = time.monotonic() - t0
         self._observe_generation(dt, dt, result.num_tokens)
         tid = trace.trace_id if trace is not None else None
+        meta = getattr(result, "meta", None)
         await self._respond_json(
             writer,
             200,
@@ -597,6 +737,10 @@ class Gateway:
                 "num_tokens": result.num_tokens,
                 "logprob": result.logprob,
                 "trace_id": tid,
+                # Per-request serving timeline (PR 10) when the backend
+                # records one (the continuous batcher's summary — the
+                # same doc /debug/requests?id= serves).
+                **({"meta": meta} if meta else {}),
             },
             {"X-Trace-Id": tid} if tid else None,
         )
@@ -671,6 +815,7 @@ class Gateway:
                 # Same discard the buffered paths apply: a shed stream
                 # did no work, and a 429 storm must not churn the ring.
                 trace = _tracing.current_trace()
+                self._record_shed("/v1/generate", trace)
                 if trace is not None:
                     _tracing.trace_store().discard(trace.trace_id)
             if headers_sent:
@@ -690,12 +835,14 @@ class Gateway:
         if first_at is None:
             self._m_ttft.observe(dt)
         self._observe_generation(None, dt, result.num_tokens)
+        meta = getattr(result, "meta", None)
         await self._sse_event(
             writer,
             {
                 "done": True,
                 "num_tokens": result.num_tokens,
                 "trace_id": self._trace_id(),
+                **({"meta": meta} if meta else {}),
             },
         )
         await self._sse_done(writer)
@@ -763,10 +910,10 @@ class Gateway:
                 result = await self.admission.submit(thunk, **adm_kw)
         except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
             status, doc, hdrs = self._error_response(e)
-            if trace is not None and isinstance(
-                e, (QueueFullError, DrainingError)
-            ):
-                _tracing.trace_store().discard(trace.trace_id)
+            if isinstance(e, (QueueFullError, DrainingError)):
+                self._record_shed("/v1/consensus", trace)
+                if trace is not None:
+                    _tracing.trace_store().discard(trace.trace_id)
             await self._respond_json(writer, status, doc, hdrs)
             self._count("/v1/consensus", status)
             return

@@ -62,6 +62,9 @@ __all__ = [
     "SPEC_VERIFIED_TOKENS",
     "ACCEPTANCE_BUCKETS",
     "TRACE_DROPPED",
+    "FLIGHT_DROPPED",
+    "TBT_SECONDS",
+    "PROGRAM_MBU",
     "PREFIX_PAGES_SHARED",
     "PREFIX_PAGES_COPIED",
     "PREFIX_LOOKUPS",
@@ -617,6 +620,48 @@ CONSENSUS_ROUND_SECONDS = REGISTRY.histogram(
 TRACE_DROPPED = REGISTRY.counter(
     "gateway_trace_dropped_total",
     "Spans/traces dropped by the bounded tracing ring buffers",
+)
+
+
+# ---------------------------------------------------------------------------
+# Serving flight recorder + roofline attribution (PR 10).
+# ---------------------------------------------------------------------------
+
+#: Events evicted from the flight recorder's bounded ring
+#: (:mod:`llm_consensus_tpu.serving.flight`) — the recorder keeps the
+#: newest ``capacity`` scheduler events and counts what it forgot, so a
+#: truncated ``GET /debug/flight`` export is detectable, never silent.
+FLIGHT_DROPPED = REGISTRY.counter(
+    "gateway_flight_dropped_total",
+    "Flight-recorder events evicted from the bounded ring",
+)
+#: Time between consecutive generated tokens as the HOST observes them
+#: (one observation per generated token past a request's first; tokens
+#: that land in the same program fetch — steps_per_sync > 1 chunks,
+#: accepted speculative runs — observe 0 for all but the first, which
+#: is exactly the bursty arrival a streaming client sees). The
+#: per-request p50/p99 summary rides ``/debug/requests`` and the
+#: response meta; TTFT for the first token stays in
+#: ``gateway_ttft_seconds`` (gateway side) + the batcher's stats()
+#: ``ttft_seconds_*`` mirror (submit-to-first-token).
+TBT_SECONDS = REGISTRY.histogram(
+    "gateway_tbt_seconds",
+    "Inter-token gap per generated token (time-between-tokens)",
+)
+#: Model-bandwidth-utilization per device-program kind, labeled
+#: ``kind="fused"|"decode"|"spec"|"prefill"``: the static cost model's
+#: HBM bytes for the most recent fetched program of that kind (weight
+#: bytes + KV page bytes actually touched, group-shared reads counted
+#: once — :func:`llm_consensus_tpu.models.transformer.program_hbm_cost`)
+#: divided by its measured wall time and by the configured peak
+#: bandwidth (``ContinuousConfig.hbm_gbps``; 0 disables the gauge —
+#: stats() still exposes the modeled-bytes / measured-seconds sums per
+#: kind so MBU can be derived offline). ~1.0 means the program kind is
+#: at the weights+KV roofline; meaningful on the chip only (a CPU
+#: "MBU" against an HBM peak is a smoke-test plumbing check).
+PROGRAM_MBU = REGISTRY.gauge(
+    "gateway_program_mbu",
+    "Model-bandwidth-utilization of the last device program, by kind",
 )
 
 

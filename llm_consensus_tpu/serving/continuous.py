@@ -71,6 +71,7 @@ is the TPU-native throughput-serving counterpart.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -111,12 +112,16 @@ from llm_consensus_tpu.models.paged_cache import (
     write_prefill_kv,
 )
 from llm_consensus_tpu.engine.accept import verify_tokens
+from llm_consensus_tpu.serving import flight as _flight
 from llm_consensus_tpu.serving.offload import HostPageStore
 from llm_consensus_tpu.models.transformer import (
     decode_step_paged,
     fused_step_paged,
+    kv_plane_token_bytes,
+    model_param_bytes,
     prefill,
     prefill_chunk_paged,
+    program_hbm_cost,
     unembed_one,
     verify_step_paged,
 )
@@ -207,9 +212,19 @@ from llm_consensus_tpu.server.metrics import (
 from llm_consensus_tpu.server.metrics import (
     SERVING_WAITING as _M_WAITING,
 )
+from llm_consensus_tpu.server.metrics import (
+    TBT_SECONDS as _M_TBT,
+)
+from llm_consensus_tpu.server.metrics import (
+    PROGRAM_MBU as _M_PROGRAM_MBU,
+)
 from llm_consensus_tpu.utils import tracing as _tracing
 
 log = logging.getLogger(__name__)
+
+# Process-wide request-id stream: ids key the (process-global)
+# RequestLog, so two batchers in one process must not collide.
+_RID = itertools.count(1)
 
 
 @dataclass
@@ -332,6 +347,18 @@ class ContinuousConfig:
     # dispatch pipeline so plain and spec programs never share a
     # window). No effect without spec_k > 0 + a draft model.
     spec_decode: bool = True
+    # Roofline attribution (PR 10): the device's peak HBM bandwidth in
+    # GB/s (1e9 bytes/s — e.g. ~819 for a v5e, ~1640 for a v5p core).
+    # > 0: every fetched device program sets
+    # gateway_program_mbu{kind} = modeled HBM bytes (weights + KV pages
+    # actually touched, per models.transformer.program_hbm_cost) /
+    # measured wall time / peak — ~1.0 means that program kind is at
+    # the weights+KV roofline, and the gap IS the remaining tok/s.
+    # 0 (default): no gauge; the modeled-bytes and measured-seconds
+    # sums still accumulate per kind in stats() (mbu_* keys) so the
+    # ratio can be derived offline against any peak. CPU values are a
+    # plumbing smoke only — MBU is meaningful on the chip.
+    hbm_gbps: float = 0.0
 
 
 @dataclass
@@ -340,6 +367,14 @@ class ServeResult:
 
     text: str
     num_tokens: int  # generated tokens incl. EOS
+    # Per-request serving timeline (PR 10): the same summary dict the
+    # RequestLog retains for /debug/requests — TTFT, inter-token-gap
+    # percentiles, spec tokens accepted per round, restored-vs-prefilled
+    # header pages. Rides the gateway response as "meta". Excluded from
+    # equality: two identical generations NEVER share wall-clock stamps,
+    # and result comparison means "same text/tokens" everywhere
+    # (parity tests compare whole ServeResults).
+    timing: dict | None = field(default=None, compare=False)
 
 
 @dataclass
@@ -367,6 +402,11 @@ class _Request:
     # worker thread attaches prefill-chunk/decode-step/restore spans to
     # it explicitly (contextvars do not cross the thread boundary).
     trace: object | None = None
+    # Flight-recorder identity + timeline origin (PR 10): rid keys the
+    # RequestLog summary; t_submit (perf_counter) anchors TTFT and the
+    # request's Chrome-export track.
+    rid: str = ""
+    t_submit: float = 0.0
 
 
 @dataclass
@@ -398,6 +438,24 @@ class _Slot:
     # without the replay the draft would write this row's next K/V at
     # stale positions and its proposals would silently stop accepting.
     draft_lag: int = 0
+    # -- per-request token timeline (PR 10) -----------------------------
+    # First-token stamp (perf_counter; TTFT = t_first - t_submit), the
+    # previous token-arrival stamp, and the observed inter-token gaps
+    # (one per token past the first; tokens landing in the same program
+    # fetch record 0 past the first — the bursty arrival a streaming
+    # client sees). Retirement folds these into the RequestLog summary.
+    t_first: float | None = None
+    t_last_tok: float = 0.0
+    gaps: list = field(default_factory=list)
+    # Speculative per-request tallies: verify rounds this row rode and
+    # draft tokens those rounds accepted for it.
+    spec_rounds: int = 0
+    spec_accepted_toks: int = 0
+    # Header provenance: full prefix pages mapped from the registry at
+    # admission vs restored from the host tier (each page is page_size
+    # prompt tokens this request never re-prefilled).
+    pages_shared_n: int = 0
+    pages_restored_n: int = 0
 
 
 @dataclass
@@ -447,6 +505,14 @@ class _Inflight:
     spec_k: int = 0
     emit_cnt: object = None  # device [slots] emitted-token counts
     counts_out: object = None  # device [slots] post-round PRNG counts
+    # -- flight recorder + roofline attribution (PR 10) -----------------
+    # The "program" flight event recorded at dispatch: the fetch fills
+    # its (t0, dur) window in place once the true device window is
+    # known. ``cost`` is the static HBM/FLOPs model for this program
+    # (program_hbm_cost output), accumulated per kind at fetch time
+    # against the measured duration.
+    flight: object = None
+    cost: dict | None = None
 
 
 class ContinuousBatcher:
@@ -616,12 +682,51 @@ class ContinuousBatcher:
         )
         self._groups = GroupTracker(c.max_slots, c.page_size)
         # KV bytes one token costs per read across all layers (k + v,
-        # pool dtype) — the unit of gateway_shared_kv_bytes_saved_total.
-        kv_dtype_bytes = jnp.dtype(self.cache.k.dtype).itemsize
-        self._kv_token_bytes = (
-            cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2 * kv_dtype_bytes
-        )
+        # pool dtype) — the unit of gateway_shared_kv_bytes_saved_total
+        # AND the cost model's KV term (one formula, transformer.py).
+        self._kv_token_bytes = kv_plane_token_bytes(cfg, self.cache.k.dtype)
         self._kv_bytes_saved = 0
+        # Roofline attribution (PR 10): the static per-program cost
+        # model's weight term is the parameter tree as it actually sits
+        # in HBM (post-shard on a mesh — leaf sizes are global either
+        # way), measured once; per-kind accumulators mirror the
+        # gateway_program_mbu gauge into stats().
+        self._weight_bytes, self._weight_params = model_param_bytes(
+            self.params
+        )
+        self._draft_weight_bytes = self._draft_weight_params = 0
+        self._draft_kv_token_bytes = 0
+        if self._draft_cfg is not None:
+            self._draft_weight_bytes, self._draft_weight_params = (
+                model_param_bytes(self._draft_params)
+            )
+            self._draft_kv_token_bytes = kv_plane_token_bytes(
+                self._draft_cfg, self.draft_cache.k.dtype
+            )
+        self._mbu = {
+            kind: {
+                "hbm_bytes": 0,
+                "flops": 0,
+                "kv_read_tokens": 0,
+                "kv_write_tokens": 0,
+                "seconds": 0.0,
+                "programs": 0,
+            }
+            for kind in ("fused", "decode", "prefill", "spec")
+        }
+        # Per-request token timeline (PR 10): stats() mirrors of the
+        # gateway_ttft-equivalent (submit -> first token, batcher side)
+        # and gateway_tbt_seconds observations — one site, two surfaces.
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
+        self._tbt_sum = 0.0
+        self._tbt_count = 0
+        # Flight-recorder change detectors: the last spec engage state
+        # (flip events record transitions, not steady state) and each
+        # row's last draft-stream donor (stream events record donor
+        # changes/divergences, not every round's plan).
+        self._spec_flip_prev: bool | None = None
+        self._stream_src_prev: dict[int, int] = {}
         self._slots: list[_Slot | None] = [None] * c.max_slots
         self._waiting: deque[_Request] = deque()
         self._last_tokens = np.zeros((c.max_slots,), np.int32)
@@ -1230,6 +1335,13 @@ class ContinuousBatcher:
                 if self._slots[i] is s and s.phase == "decode"
             ]
         for idx, slot in lagging:
+            _flight.flight_recorder().record(
+                "spec_catch_up",
+                time.perf_counter(),
+                trace_id=_tracing.trace_id_of(slot.request.trace),
+                slot=idx,
+                lag=slot.draft_lag,
+            )
             # Newest committed token's K/V is pending in BOTH caches
             # (the round input), so the draft must cover [dlen, tlen).
             tlen = slot.prompt_len + len(slot.generated) - 1
@@ -1356,6 +1468,8 @@ class ContinuousBatcher:
             stop=stop,
             stop_window=window,
             trace=_tracing.current_trace(),
+            rid=f"req-{next(_RID)}",
+            t_submit=time.perf_counter(),
         )
         with self._lock:
             self._waiting.append(req)
@@ -1485,6 +1599,33 @@ class ContinuousBatcher:
                 "spec_acceptance_count": self._spec_acc_count,
                 "spec_verified_tokens_last": self._spec_verified_last,
                 "spec_shared_draft_rows": self._spec_shared_rows,
+                # Per-request token timeline (PR 10) — the same
+                # observations behind gateway_tbt_seconds (lockstep
+                # tested); ttft here is the batcher's submit-to-first-
+                # token (the gateway's gateway_ttft_seconds keeps its
+                # arrival-to-first-byte view; both move once per
+                # request).
+                "ttft_seconds_sum": self._ttft_sum,
+                "ttft_seconds_count": self._ttft_count,
+                "tbt_seconds_sum": self._tbt_sum,
+                "tbt_seconds_count": self._tbt_count,
+                # Roofline attribution (PR 10): per-program-kind sums
+                # of the static cost model (modeled HBM bytes, FLOPs,
+                # target-pool KV tokens touched) next to the measured
+                # program seconds — gateway_program_mbu's inputs, so
+                # MBU is derivable offline against any peak bandwidth.
+                **{
+                    f"mbu_{key}_{kind}": m[key]
+                    for kind, m in self._mbu.items()
+                    for key in (
+                        "hbm_bytes",
+                        "flops",
+                        "kv_read_tokens",
+                        "kv_write_tokens",
+                        "seconds",
+                        "programs",
+                    )
+                },
             }
 
     def close(self) -> None:
@@ -1782,6 +1923,19 @@ class ContinuousBatcher:
                     s_bucket=bucket,
                     deps=deps,
                     reg_nodes=reg_nodes,
+                    pages_shared_n=len(shared_pages),
+                    pages_restored_n=len(restore_plan),
+                )
+                _flight.flight_recorder().record(
+                    "admit",
+                    time.perf_counter(),
+                    trace_id=_tracing.trace_id_of(req.trace),
+                    id=req.rid,
+                    slot=i,
+                    prompt_tokens=L,
+                    pages_shared=len(shared_pages),
+                    pages_restored=len(restore_plan),
+                    boundary_copy=bool(boundary),
                 )
                 return True
         return False
@@ -1795,6 +1949,9 @@ class ContinuousBatcher:
         src, dst = self._pending_copy
         self._pending_copy = None
         self._flush_pipeline()
+        _flight.flight_recorder().record(
+            "cow_copy", time.perf_counter(), src=int(src), dst=int(dst)
+        )
         self.cache = self._jit_copy_page(
             self.cache, jnp.int32(src), jnp.int32(dst)
         )
@@ -1822,6 +1979,9 @@ class ContinuousBatcher:
         if not self._inflight:
             return
         _M_PIPELINE_FLUSHES.inc()
+        _flight.flight_recorder().record(
+            "flush", time.perf_counter(), inflight=len(self._inflight)
+        )
         with self._lock:
             self._pipeline_flushes += 1
         while self._inflight:
@@ -1844,7 +2004,9 @@ class ContinuousBatcher:
         demoted0 = store.demoted_pages
         dropped0 = store.dropped_pages
         fetch: list[tuple[tuple, int]] = []
+        n_nodes = 0
         for node in nodes:
+            n_nodes += 1
             key = PrefixRegistry.chain_tokens(node)
             if key in store:
                 store.touch(key)
@@ -1873,6 +2035,12 @@ class ContinuousBatcher:
         _M_OFF_DEMOTED.inc(store.demoted_pages - demoted0)
         _M_OFF_DROPPED.inc(store.dropped_pages - dropped0)
         _M_OFF_HOST_BYTES.set(store.bytes_used)
+        _flight.flight_recorder().record(
+            "demote",
+            time.perf_counter(),
+            pages=len(fetch),
+            refreshed=n_nodes - len(fetch),
+        )
 
     def _restore_step(self) -> bool:
         """Promote ONE host-tier page back into the device pool.
@@ -1916,17 +2084,28 @@ class ContinuousBatcher:
         _M_RESTORE_SECONDS.observe(dur)
         if trace is not None:
             trace.add_span("kv_restore", t0, dur, page=int(node.page))
+        _flight.flight_recorder().record(
+            "restore",
+            t0,
+            dur,
+            trace_id=_tracing.trace_id_of(trace),
+            page=int(node.page),
+        )
         node.ready = True
         _M_OFF_RESTORED.inc()
         with self._lock:
             self._offload_restored += 1
         return True
 
-    def _count_program(self, kind: str, rows: int | None = None) -> None:
+    def _count_program(self, kind: str, rows: int | None = None):
         """One device program dispatched by the scheduler loop: feed
-        the Prometheus families and the stats() mirrors from the same
-        site (lockstep). ``rows``: ragged-row occupancy for
-        fused/decode programs (decode rows + chunk lanes)."""
+        the Prometheus families, the stats() mirrors, AND the flight
+        recorder from the same site (lockstep — the Chrome export's
+        device track reconstructs exactly the programs this counted).
+        ``rows``: ragged-row occupancy for fused/decode programs
+        (decode rows + chunk lanes). Returns the flight event (None
+        when recording is off) so pipelined callers can fill in the
+        true device window in place once the fetch lands."""
         _M_DEVICE_PROGRAMS.labels(kind=kind).inc()
         with self._lock:
             self._programs[kind] += 1
@@ -1935,6 +2114,116 @@ class ContinuousBatcher:
                 self._ragged_rows_count += 1
         if rows is not None:
             _M_RAGGED_ROWS.observe(rows)
+        meta = {"kind": kind}
+        if rows is not None:
+            meta["rows"] = rows
+        if kind == "draft":
+            # Draft mirror programs are dispatched async and never
+            # individually fetched (their completion is implied by
+            # stream order behind the carrying program) — their event
+            # is a dispatch-stamp annotation, not a measured window.
+            meta["untimed"] = 1
+        return _flight.flight_recorder().record(
+            "program", time.perf_counter(), meta=meta
+        )
+
+    def _program_cost(
+        self,
+        kind: str,
+        rows_now: list,
+        k: int,
+        chunk_ext: tuple[int, int] | None = None,
+        streams: int = 0,
+    ) -> dict:
+        """Static HBM/FLOPs model for ONE dispatched program (PR 10).
+
+        ``kv_read/write_tokens`` count the TARGET pool only and mirror
+        what the program actually touches: a decode row at committed
+        length L reads L + j positions at step j (k steps per
+        program); a speculative verify row reads its pages ONCE for
+        all k+1 queries (the ragged kernel folds each page one time —
+        the reason a spec program's KV read equals a plain decode
+        program's over the same rows) and writes k+1 positions of
+        which a rejected tail is rewound (written traffic either way);
+        a chunk lane (``chunk_ext = (read_end, width)``) reads the
+        pages covering [0, read_end) and writes its width. Group-
+        shared prefix reads are deducted exactly as
+        :meth:`_dispatch_tail` counts them saved — the two accountings
+        cannot drift apart without a test noticing. The draft side of
+        a spec program adds k+1 reads of the draft tree plus the
+        streams' draft KV to hbm_bytes/flops only (the kv_*_tokens
+        fields stay target-pool so the spec-on/off write-parity
+        invariant is assertable).
+        """
+        kv_read = kv_write = tokens = 0
+        lengths = []
+        for _, s in rows_now:
+            L = s.prompt_len + len(s.generated)
+            lengths.append(L)
+            if kind == "spec":
+                kv_read += L + k
+                kv_write += k + 1
+                tokens += k + 1
+            else:
+                kv_read += k * L + k * (k - 1) // 2
+                kv_write += k
+                tokens += k
+        if self._group_decode and rows_now:
+            shared_steps = 1 if kind == "spec" else k
+            kv_read -= min(
+                kv_read, self._groups.saved_tokens_per_step * shared_steps
+            )
+        if chunk_ext is not None:
+            read_end, width = chunk_ext
+            kv_read += read_end
+            kv_write += width
+            tokens += width
+        cost = program_hbm_cost(
+            self.cfg,
+            weight_bytes=self._weight_bytes,
+            weight_params=self._weight_params,
+            kv_token_bytes=self._kv_token_bytes,
+            kv_read_tokens=kv_read,
+            kv_write_tokens=kv_write,
+            tokens=tokens,
+        )
+        if kind == "spec":
+            mean_len = sum(lengths) // max(1, len(lengths))
+            d_tokens = (k + 1) * max(1, streams)
+            d = program_hbm_cost(
+                self._draft_cfg,
+                # The draft scan streams the draft tree once per step.
+                weight_bytes=(k + 1) * self._draft_weight_bytes,
+                weight_params=self._draft_weight_params,
+                kv_token_bytes=self._draft_kv_token_bytes,
+                kv_read_tokens=d_tokens * mean_len,
+                kv_write_tokens=d_tokens,
+                tokens=d_tokens,
+            )
+            cost["hbm_bytes"] += d["hbm_bytes"]
+            cost["flops"] += d["flops"]
+        return cost
+
+    def _mbu_account(self, kind: str, cost: dict | None, dur: float) -> None:
+        """Fold one fetched program's modeled cost + measured duration
+        into the per-kind accumulators and — with a configured peak
+        bandwidth — the gateway_program_mbu{kind} gauge. One site,
+        two surfaces (stats mbu_* mirrors; lockstep tested)."""
+        if cost is None:
+            return
+        with self._lock:
+            m = self._mbu[kind]
+            m["hbm_bytes"] += cost["hbm_bytes"]
+            m["flops"] += cost["flops"]
+            m["kv_read_tokens"] += cost["kv_read_tokens"]
+            m["kv_write_tokens"] += cost["kv_write_tokens"]
+            m["seconds"] += dur
+            m["programs"] += 1
+        peak = self.config.hbm_gbps * 1e9
+        if peak > 0 and dur > 0:
+            _M_PROGRAM_MBU.labels(kind=kind).set(
+                cost["hbm_bytes"] / dur / peak
+            )
 
     def _pick_prefill_slot(self) -> int | None:
         """Next ready prefilling slot — deps satisfied and chunks still
@@ -1973,7 +2262,7 @@ class ContinuousBatcher:
             # and cost ~nothing afterwards.
             jax.block_until_ready(self.cache.length)
         t0 = time.perf_counter()
-        self._count_program("prefill")
+        ev = self._count_program("prefill")
         chunk_ids = slot.padded_ids[slot.next_pos : slot.next_pos + slot.chunk]
         hidden, self.cache = self._chunk_fn(slot.chunk, slot.s_bucket)(
             self.params,
@@ -1999,6 +2288,24 @@ class ContinuousBatcher:
         jax.block_until_ready(self.cache.length)
         dur = time.perf_counter() - t0
         _M_PREFILL_STALL.observe(dur)
+        if ev is not None:
+            # Standalone chunk programs are host-blocking: the device
+            # window IS [t0, t0 + dur] — fill the flight event now.
+            # Meta is REPLACED, not mutated: a concurrent /debug/flight
+            # export may be iterating the old dict.
+            ev.t0 = t0
+            ev.dur = dur
+            ev.meta = {
+                **ev.meta, "slot": idx, "pos": slot.next_pos,
+                "width": slot.chunk,
+            }
+        self._mbu_account(
+            "prefill",
+            self._program_cost(
+                "prefill", [], 0, chunk_ext=(written_end, slot.chunk)
+            ),
+            dur,
+        )
         trace = slot.request.trace
         if trace is not None:
             trace.add_span(
@@ -2058,6 +2365,13 @@ class ContinuousBatcher:
         slot.generated.append(first)
         slot.phase = "decode"
         slot.deps = []
+        # First generated token: the request's TTFT anchor (batcher
+        # side — submit to first token; the gateway's
+        # gateway_ttft_seconds keeps its arrival-to-first-byte view)
+        # and the origin of the inter-token-gap timeline.
+        now = time.perf_counter()
+        slot.t_first = now
+        slot.t_last_tok = now
         if self._group_decode or self.draft_cache is not None:
             # The row's prompt-prefix page run (full pages only — the
             # boundary page takes decode writes and must stay suffix).
@@ -2072,8 +2386,17 @@ class ContinuousBatcher:
             self._groups.add(
                 idx, slot.pages[: slot.prompt_len // self.config.page_size]
             )
+            _flight.flight_recorder().record(
+                "group",
+                now,
+                trace_id=_tracing.trace_id_of(req.trace),
+                slot=idx,
+                largest=self._groups.largest_group,
+            )
         with self._lock:
             _M_ACTIVE.set(self._decoding())
+            self._ttft_sum += now - req.t_submit
+            self._ttft_count += 1
         self._last_tokens[idx] = first
         # The next dispatch must feed THIS row from the host mirror:
         # its first token came from prefill logits, not from the
@@ -2133,6 +2456,15 @@ class ContinuousBatcher:
             phase="prefill",  # not decodable until the prefill lands
         )
         self._dense_pending = free_slot
+        _flight.flight_recorder().record(
+            "admit",
+            time.perf_counter(),
+            trace_id=_tracing.trace_id_of(req.trace),
+            id=req.rid,
+            slot=free_slot,
+            prompt_tokens=len(req.prompt_ids),
+            dense=1,
+        )
         return True
 
     def _dense_prefill_pending(self) -> None:
@@ -2145,7 +2477,7 @@ class ContinuousBatcher:
         slot = self._slots[idx]
         req = slot.request
         t0 = time.perf_counter()
-        self._count_program("prefill")
+        ev = self._count_program("prefill")
         s_bucket = self._bucket(len(req.prompt_ids))
         slot.s_bucket = s_bucket  # program-family key (draft catch-up)
         padded = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
@@ -2178,9 +2510,32 @@ class ContinuousBatcher:
             )
         first = self._sample_first(req, logits)
         jax.block_until_ready(self.cache.length)
+        dur = time.perf_counter() - t0
         # The whole-prompt stall this path pays per admission — the
         # number the chunked scheduler bounds to one chunk.
-        _M_PREFILL_STALL.observe(time.perf_counter() - t0)
+        _M_PREFILL_STALL.observe(dur)
+        if ev is not None:
+            ev.t0 = t0
+            ev.dur = dur
+            ev.meta = {
+                **ev.meta, "slot": idx, "pos": 0,
+                "width": s_bucket, "dense": 1,
+            }
+        # Dense prefill computes attention in-program (no paged KV
+        # reads); its pool traffic is the prompt's K/V scatter.
+        self._mbu_account(
+            "prefill",
+            program_hbm_cost(
+                self.cfg,
+                weight_bytes=self._weight_bytes,
+                weight_params=self._weight_params,
+                kv_token_bytes=self._kv_token_bytes,
+                kv_read_tokens=0,
+                kv_write_tokens=len(req.prompt_ids),
+                tokens=s_bucket,
+            ),
+            dur,
+        )
         self._activate(idx, slot, first)
 
     def _decoded_text(self, slot: _Slot) -> str:
@@ -2206,6 +2561,42 @@ class ContinuousBatcher:
             lambda: self._decoded_text(slot),
         )
 
+    def _request_summary(self, slot: _Slot) -> dict:
+        """The per-request serving timeline (PR 10): TTFT, inter-token
+        gap percentiles, speculation tallies, and header-page
+        provenance. Retained in the process RequestLog (served at
+        ``GET /debug/requests``) and attached to the ServeResult so the
+        gateway can surface it as response meta."""
+        req = slot.request
+        end = time.perf_counter()
+        gaps = slot.gaps
+        return {
+            "id": req.rid,
+            "trace_id": _tracing.trace_id_of(req.trace),
+            "prompt_tokens": slot.prompt_len,
+            "new_tokens": len(slot.generated),
+            "ttft_s": (
+                slot.t_first - req.t_submit
+                if slot.t_first is not None
+                else None
+            ),
+            "duration_s": end - req.t_submit,
+            "tbt_p50_s": _flight.percentile(gaps, 50),
+            "tbt_p99_s": _flight.percentile(gaps, 99),
+            "tbt_max_s": max(gaps) if gaps else 0.0,
+            "tbt_count": len(gaps),
+            "spec_rounds": slot.spec_rounds,
+            "spec_accepted_tokens": slot.spec_accepted_toks,
+            "spec_accepted_per_round": (
+                slot.spec_accepted_toks / slot.spec_rounds
+                if slot.spec_rounds
+                else 0.0
+            ),
+            "header_pages_shared": slot.pages_shared_n,
+            "header_pages_restored": slot.pages_restored_n,
+            "finished_at": time.time(),
+        }
+
     def _retire(self, idx: int) -> None:
         slot = self._slots[idx]
         assert slot is not None
@@ -2213,6 +2604,7 @@ class ContinuousBatcher:
         # with one member stops emitting (its row falls back to the
         # plain per-row walk — nothing left to dedup).
         self._groups.remove(idx)
+        self._stream_src_prev.pop(idx, None)
         self.cache = release_seq(self.cache, jnp.int32(idx))
         if self.draft_cache is not None:
             self.draft_cache = release_seq(self.draft_cache, jnp.int32(idx))
@@ -2237,11 +2629,24 @@ class ContinuousBatcher:
         cut = earliest_stop_cut(text, slot.request.stop)
         if cut >= 0:
             text = text[:cut]
+        summary = self._request_summary(slot)
+        _flight.request_log().add(summary)
+        # The Chrome export's per-request track: one slice spanning
+        # submit to retirement, joined to /debug/traces by trace id.
+        _flight.flight_recorder().record(
+            "request",
+            slot.request.t_submit,
+            summary["duration_s"],
+            trace_id=summary.get("trace_id"),
+            id=summary["id"],
+            tokens=len(slot.generated),
+        )
         if not slot.request.future.done():
             slot.request.future.set_result(
                 ServeResult(
                     text=text,
                     num_tokens=len(slot.generated),
+                    timing=summary,
                 )
             )
 
@@ -2323,6 +2728,15 @@ class ContinuousBatcher:
             with self._lock:
                 self._sched_overhead_sum += overhead
                 self._sched_overhead_count += 1
+            if overhead > 0 and self._last_step_end is not None:
+                # The Chrome export's host track: un-overlapped
+                # scheduler work between the pipeline draining and this
+                # dispatch (overlapped dispatches observe 0 and emit
+                # nothing — the track shows exactly the time the device
+                # sat idle waiting on the host).
+                _flight.flight_recorder().record(
+                    "host", self._last_step_end, overhead
+                )
         self._last_step_end = None
         # Snapshot rule as rows(): _tok_dirty is reset and _last_tokens
         # mutated right after this dispatch; the spec branch reuses the
@@ -2359,6 +2773,22 @@ class ContinuousBatcher:
             src, fill, off, streams, shared = self._spec_stream_plan(
                 rows_now
             )
+            # Flight events for stream-plan CHANGES only (the plan
+            # itself re-runs every round): a mate picking up a new
+            # donor, or falling back to drafting for itself (diverge).
+            for i, _ in rows_now:
+                cur = int(src[i])
+                prev = self._stream_src_prev.get(i)
+                if prev is not None and prev != cur:
+                    _flight.flight_recorder().record(
+                        "stream_donor",
+                        t0,
+                        slot=i,
+                        donor=cur,
+                        prev=prev,
+                        diverged=cur == i,
+                    )
+                self._stream_src_prev[i] = cur
             emit, emit_cnt, self.cache, self.draft_cache, next_in, cnt_out = (
                 self._jit_spec(
                     c.spec_k,
@@ -2382,7 +2812,10 @@ class ContinuousBatcher:
                     rows(off),
                 )
             )
-            self._count_program("spec", rows=len(rows_now))
+            ev = self._count_program("spec", rows=len(rows_now))
+            cost = self._program_cost(
+                "spec", rows_now, c.spec_k, streams=streams
+            )
             drafted = c.spec_k * streams
             _M_SPEC_DRAFTED.inc(drafted)
             with self._lock:
@@ -2400,6 +2833,8 @@ class ContinuousBatcher:
                 spec_k=c.spec_k,
                 emit_cnt=emit_cnt,
                 counts_out=cnt_out,
+                flight=ev,
+                cost=cost,
             )
             return self._dispatch_tail(rec, groups, k)
         args = (
@@ -2417,7 +2852,8 @@ class ContinuousBatcher:
         chunk_rec = None
         if chunk_idx is None:
             next_tok, _, self.cache, next_in = self._jit_decode(*args)
-            self._count_program("decode", rows=len(rows_now))
+            ev = self._count_program("decode", rows=len(rows_now))
+            cost = self._program_cost("decode", rows_now, k)
         else:
             slot = self._slots[chunk_idx]
             chunk_ids = slot.padded_ids[
@@ -2435,7 +2871,19 @@ class ContinuousBatcher:
                 jnp.int32(slot.prompt_len - 1),
                 chunk_done,
             )
-            self._count_program("fused", rows=len(rows_now) + 1)
+            ev = self._count_program("fused", rows=len(rows_now) + 1)
+            cost = self._program_cost(
+                "fused", rows_now, k, chunk_ext=(written_end, slot.chunk)
+            )
+            _flight.flight_recorder().record(
+                "chunk",
+                t0,
+                trace_id=_tracing.trace_id_of(slot.request.trace),
+                slot=chunk_idx,
+                pos=slot.next_pos,
+                width=slot.chunk,
+                fused=1,
+            )
             if self.draft_cache is not None:
                 # The draft's mirror of the riding chunk — its own
                 # small program right behind the fused dispatch (the
@@ -2474,7 +2922,7 @@ class ContinuousBatcher:
                 s.draft_lag += k
         rec = _Inflight(
             tokens=next_tok, next_input=next_in, t0=t0, k=k,
-            rows=rows_now, chunk=chunk_rec,
+            rows=rows_now, chunk=chunk_rec, flight=ev, cost=cost,
         )
         self._dispatch_tail(rec, groups, k)
 
@@ -2531,6 +2979,17 @@ class ContinuousBatcher:
         self._last_step_end = step_end if not self._inflight else None
         self._hb_step = time.monotonic()
         _M_STEP_SECONDS.observe(dur)
+        if rec.flight is not None:
+            # Fill the dispatch-time flight event with the TRUE device
+            # window (same correction _M_STEP_SECONDS uses): the Chrome
+            # export's device track is these windows back to back.
+            rec.flight.t0 = start
+            rec.flight.dur = dur
+        self._mbu_account(
+            "spec" if rec.spec else ("fused" if rec.chunk else "decode"),
+            rec.cost,
+            dur,
+        )
         _M_DISPATCH_INFLIGHT.set(len(self._inflight))
         alive = [(i, s) for i, s in rec.rows if self._slots[i] is s]
         with self._lock:
@@ -2563,11 +3022,15 @@ class ContinuousBatcher:
             # count and marked it dirty, so the mirror stays right.
             emitted = 0
             accepted = 0
-            for i, _ in alive:
+            for i, s in alive:
                 n = int(cnt_np[i])
                 self._counts[i] += n
                 emitted += n
                 accepted += n - 1
+                # Per-request speculation tallies (the "spec tokens
+                # accepted per round" line of the request summary).
+                s.spec_rounds += 1
+                s.spec_accepted_toks += n - 1
             if alive:
                 _M_SPEC_ACCEPTED.inc(accepted)
                 frac = accepted / (rec.spec_k * len(alive))
@@ -2578,6 +3041,8 @@ class ContinuousBatcher:
                     self._spec_acc_sum += frac
                     self._spec_acc_count += 1
                     self._spec_verified_last = emitted
+        emitted_total = 0
+        tbt_sum, tbt_count = 0.0, 0
         for i, slot in alive:
             done = False
             n_emit = int(cnt_np[i]) if rec.spec else rec.k
@@ -2585,6 +3050,19 @@ class ContinuousBatcher:
                 tok = int(next_np[i, j])
                 slot.generated.append(tok)
                 self._last_tokens[i] = tok
+                # Token-timeline stamp (PR 10): tokens surface at the
+                # fetch — the first of this fetch carries the gap since
+                # the row's previous token, the rest arrived with it
+                # (gap 0), which is exactly what a streaming client
+                # observes. One observation per generated token past
+                # the request's first (that one is TTFT's).
+                gap = step_end - slot.t_last_tok if j == 0 else 0.0
+                slot.t_last_tok = step_end
+                slot.gaps.append(gap)
+                _M_TBT.observe(gap)
+                tbt_sum += gap
+                tbt_count += 1
+                emitted_total += 1
                 done = (
                     tok == self.tokenizer.eos_id
                     or len(slot.generated) >= slot.request.max_new_tokens
@@ -2596,6 +3074,14 @@ class ContinuousBatcher:
                     break
             if done:
                 self._retire(i)
+        if tbt_count:
+            with self._lock:
+                self._tbt_sum += tbt_sum
+                self._tbt_count += tbt_count
+        if rec.flight is not None:
+            # Replace, never mutate: a concurrent export may hold the
+            # old meta dict.
+            rec.flight.meta = {**rec.flight.meta, "tokens": emitted_total}
         ch = rec.chunk
         if ch is not None and self._slots[ch.idx] is ch.slot:
             # Fused prefill chunk (PR 8): host bookkeeping deferred to
@@ -2651,6 +3137,17 @@ class ContinuousBatcher:
             # the verify program IS the decode dispatch, and a chunk
             # lane on it is future work.
             spec_now = self._spec_ok
+            if self._draft_cfg is not None:
+                # Flight event on TRANSITIONS only (spec_decode is read
+                # per iteration; steady state records nothing).
+                if (
+                    self._spec_flip_prev is not None
+                    and self._spec_flip_prev != spec_now
+                ):
+                    _flight.flight_recorder().record(
+                        "spec_flip", time.perf_counter(), on=spec_now
+                    )
+                self._spec_flip_prev = spec_now
             # The fused scheduler step (PR 8): a ready chunk rides the
             # decode dispatch as one more ragged-kernel row — ONE
             # device program per iteration instead of chunk-then-
@@ -2770,7 +3267,9 @@ class ContinuousBackend(_backend_base.Backend):
             raise BackendError(f"continuous submit failed: {e}") from e
         outs = await asyncio.gather(*(asyncio.wrap_future(f) for f in futs))
         return [
-            GenerationResult(text=o.text, num_tokens=o.num_tokens)
+            GenerationResult(
+                text=o.text, num_tokens=o.num_tokens, meta=o.timing
+            )
             for o in outs
         ]
 

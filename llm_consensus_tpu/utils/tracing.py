@@ -423,6 +423,16 @@ def current_trace() -> Trace | None:
     return ctx[0] if ctx is not None else None
 
 
+def trace_id_of(trace: Trace | None) -> str | None:
+    """The id of a trace-or-None handle — the stamp every flight
+    recorder event and request summary carries (PR 10), so the span
+    tree at ``/debug/traces?id=``, the timeline at ``/debug/flight``,
+    and the summary at ``/debug/requests?id=`` all join on one key.
+    None-safe because every handle in the serving stack is None when
+    tracing is disabled."""
+    return trace.trace_id if trace is not None else None
+
+
 @contextlib.contextmanager
 def use_trace(trace: Trace | None):
     """Make ``trace`` the context's current trace (no-op for None)."""

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Bench-history trajectory + regression gate (PR 10).
+
+Aggregates the per-round chip artifacts the driver commits at the repo
+root — ``BENCH_r*.json`` (the headline candidate-tokens/sec/chip leg)
+and ``MULTICHIP_r*.json`` (the 8-device dryrun) — into one trajectory
+table and a regression VERDICT, enforced in CI next to
+``scripts/check_metrics.py``.
+
+The one rule that must never regress: a **CHIP UNREACHABLE round is
+no-data, never a 0-tok/s measurement**. Rounds 4 and 5 committed
+``{"value": 0.0, "unit": "tokens/sec/chip"}`` rows for a dead tunnel
+(rc != 0) — naive tooling averaging or min-ing those would report a
+catastrophic regression that never happened, and tooling keying
+regressions off "latest value" would fire on every outage. A round
+counts as a measurement only when its subprocess rc is 0 AND its
+parsed payload says so (the ``status`` field bench.py now emits;
+legacy rows without one fall back to the rc / metric-string / zero-
+value heuristics this script centralizes).
+
+Verdict semantics (``--check`` exits 1 only on REGRESSION):
+
+- no measured rounds at all -> ``no-data`` (exit 0)
+- the newest measured round >= threshold * best earlier measured
+  round -> ``ok``
+- below the threshold (default 0.85 — chip rounds jitter run to run;
+  see the r3/r4 llama-1b medians in README) -> ``regression``
+- measured rounds exist but the LATEST round is no-data -> ``stale``
+  (exit 0: an outage must not block CI, the trajectory just flags it)
+
+Stdlib-only, < 1 s, runs anywhere (no jax import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _round_no(path: Path) -> int:
+    m = re.search(r"_r(\d+)\.json$", path.name)
+    return int(m.group(1)) if m else -1
+
+
+def load_bench_round(path: Path) -> dict:
+    """One BENCH_r*.json -> {round, status, value?, unit?, metric?}.
+
+    ``status``: "ok" (a real measurement), "chip-unreachable" (the
+    explicit no-data record), or "no-data" (rc != 0, unparseable, or a
+    legacy zero-value unreachable row without a status field).
+    """
+    rnd = _round_no(path)
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        return {"round": rnd, "status": "no-data", "note": f"unreadable: {e}"}
+    parsed = doc.get("parsed")
+    rc = doc.get("rc")
+    # The artifact may be the raw bench emission itself (bench.py
+    # --out) rather than the driver's wrapper.
+    if parsed is None and "metric" in doc:
+        parsed, rc = doc, 0
+    if parsed is None or not isinstance(parsed, dict):
+        return {
+            "round": rnd,
+            "status": "no-data",
+            "note": f"rc={rc}, no parsed payload",
+        }
+    # A malformed value (string, list, ...) is an artifact-format
+    # problem — by this module's contract that is no-data, never a
+    # gate-crashing traceback.
+    try:
+        value = float(parsed.get("value") or 0.0)
+    except (TypeError, ValueError):
+        return {
+            "round": rnd,
+            "status": "no-data",
+            "note": f"malformed value {parsed.get('value')!r}",
+        }
+    status = parsed.get("status")
+    if status is None:
+        # Legacy rows (pre-PR-10 bench.py): infer. rc != 0 or an
+        # explicit CHIP UNREACHABLE metric string is the outage
+        # record; so is a 0.0 tokens/sec/chip value (a chip that
+        # answered cannot measure 0).
+        metric = str(parsed.get("metric", ""))
+        if "CHIP UNREACHABLE" in metric:
+            status = "chip-unreachable"
+        elif rc not in (0, None):
+            status = "no-data"
+        elif not value:
+            status = "no-data"
+        else:
+            status = "ok"
+    elif status == "ok" and rc not in (0, None):
+        # A payload claiming ok under a failing subprocess is still
+        # not a measurement (partial leg, killed mid-run).
+        status = "no-data"
+    out = {"round": rnd, "status": status}
+    if status == "ok":
+        out["value"] = value
+        out["unit"] = parsed.get("unit", "")
+        out["metric"] = str(parsed.get("metric", ""))[:100]
+    return out
+
+
+def load_multichip_round(path: Path) -> dict:
+    rnd = _round_no(path)
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        return {"round": rnd, "status": "no-data", "note": f"unreadable: {e}"}
+    if doc.get("skipped"):
+        return {"round": rnd, "status": "skipped"}
+    ok = bool(doc.get("ok")) and doc.get("rc") == 0
+    return {"round": rnd, "status": "ok" if ok else "no-data"}
+
+
+def history(root: Path) -> dict:
+    bench = [
+        load_bench_round(p)
+        for p in sorted(root.glob("BENCH_r*.json"), key=_round_no)
+    ]
+    multi = [
+        load_multichip_round(p)
+        for p in sorted(root.glob("MULTICHIP_r*.json"), key=_round_no)
+    ]
+    return {"bench": bench, "multichip": multi}
+
+
+def verdict(bench: list[dict], threshold: float) -> dict:
+    measured = [r for r in bench if r["status"] == "ok"]
+    if not measured:
+        return {
+            "verdict": "no-data",
+            "detail": "no measured bench rounds (outage rounds are "
+            "no-data, never 0-tok/s measurements)",
+        }
+    latest = measured[-1]
+    earlier = measured[:-1]
+    doc = {
+        "latest_measured_round": latest["round"],
+        "latest_value": latest["value"],
+        "unit": latest.get("unit", ""),
+    }
+    if bench and bench[-1]["status"] != "ok":
+        # Outage tail: nothing new to gate — flag staleness, pass CI.
+        return {
+            **doc,
+            "verdict": "stale",
+            "detail": f"round {bench[-1]['round']} is "
+            f"{bench[-1]['status']}; last measurement is round "
+            f"{latest['round']} ({latest['value']:.1f})",
+        }
+    if not earlier:
+        return {**doc, "verdict": "ok", "detail": "first measured round"}
+    best = max(earlier, key=lambda r: r["value"])
+    ratio = latest["value"] / best["value"] if best["value"] else 1.0
+    doc.update(
+        best_earlier_round=best["round"],
+        best_earlier_value=best["value"],
+        ratio=round(ratio, 4),
+        threshold=threshold,
+    )
+    if ratio < threshold:
+        return {
+            **doc,
+            "verdict": "regression",
+            "detail": f"round {latest['round']} measured "
+            f"{latest['value']:.1f} vs best earlier "
+            f"{best['value']:.1f} (r{best['round']:02d}): ratio "
+            f"{ratio:.3f} < {threshold}",
+        }
+    return {**doc, "verdict": "ok", "detail": f"ratio {ratio:.3f}"}
+
+
+def main(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--dir", default=str(ROOT), help="directory holding the artifacts"
+    )
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=0.85,
+        help="regression floor: latest measured value must stay above "
+        "threshold * best earlier measured value (chip rounds jitter "
+        "run to run — see the r3/r4 medians)",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 on a regression verdict (CI mode; no-data and "
+        "stale pass — an outage must not block the gate)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="emit the table as JSON"
+    )
+    args = p.parse_args(argv)
+    h = history(Path(args.dir))
+    v = verdict(h["bench"], args.threshold)
+    if args.json:
+        print(json.dumps({**h, "verdict": v}, indent=2))
+    else:
+        print("round  bench                          multichip")
+        multi_by_round = {m["round"]: m for m in h["multichip"]}
+        for r in h["bench"]:
+            if r["status"] == "ok":
+                cell = f"{r['value']:>10.1f} {r.get('unit', '')}"
+            else:
+                cell = f"{'—':>10} ({r['status']})"
+            m = multi_by_round.get(r["round"])
+            mcell = m["status"] if m else "—"
+            print(f"r{r['round']:02d}   {cell:<30} {mcell}")
+        for m in h["multichip"]:
+            if m["round"] not in {r["round"] for r in h["bench"]}:
+                print(f"r{m['round']:02d}   {'—':>10} {'':<19} {m['status']}")
+        print(f"verdict: {v['verdict']} — {v['detail']}")
+    if args.check and v["verdict"] == "regression":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -13,17 +13,22 @@ cd "$(dirname "$0")/.." || exit 1
 # must be declared in server/metrics.py and documented in the README
 # observability table. Stdlib-only, < 1 s.
 python scripts/check_metrics.py || exit 1
+# Bench-history gate (PR 10): the chip-round trajectory's regression
+# verdict — CHIP UNREACHABLE rounds count as no-data, never as 0-tok/s
+# measurements. Stdlib-only, < 1 s.
+python scripts/bench_history.py --check || exit 1
 if [ "$1" = "--smoke" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_paged_cache.py tests/test_server.py \
     tests/test_shared_prefix_attention.py tests/test_kv_offload.py \
     tests/test_tracing.py tests/test_decode_pipeline.py \
     tests/test_ragged_attention.py tests/test_serve_speculative.py \
+    tests/test_flight.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 set -o pipefail
 rm -f /tmp/_t1.log
-timeout -k 10 2280 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+timeout -k 10 2520 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 exit $rc

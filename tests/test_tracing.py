@@ -511,6 +511,7 @@ def test_check_metrics_detects_undeclared_family(tmp_path):
         "llm_consensus_tpu/serving/continuous.py",
         "llm_consensus_tpu/serving/scheduler.py",
         "llm_consensus_tpu/serving/offload.py",
+        "llm_consensus_tpu/serving/flight.py",
         "llm_consensus_tpu/server/gateway.py",
         "llm_consensus_tpu/server/admission.py",
         "llm_consensus_tpu/consensus/coordinator.py",
@@ -560,6 +561,10 @@ def test_bench_serve_trace_overhead_cpu_ab_leg(tmp_path):
     m = payload["metric"]
     assert "request tracing ON" in m
     assert int(re.search(r"(\d+) spans", m).group(1)) > 0
-    # vs_baseline is on/off: the gate already enforced >= its floor.
-    assert payload["vs_baseline"] > 0.9
+    # rc 0 means the DUAL gate held (per-leg bests OR paired median) —
+    # re-imposing a hard best-ratio floor here re-creates the exact
+    # single-estimator flake the dual gate exists to absorb (PR 10
+    # measured vs_baseline swinging 0.30..1.14 across clean runs of a
+    # throttled box while the paired median stayed well inside 2%).
+    assert payload["vs_baseline"] > 0
     assert list(out.parent.glob("*.tmp.*")) == []
