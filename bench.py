@@ -468,6 +468,28 @@ def main() -> int:
         help="alternating off/on paired rounds for --serve-speculative",
     )
     p.add_argument(
+        "--serve-decode-rounds",
+        action="store_true",
+        help="multi-round on-device decode A/B leg (PR 12): the same "
+        "greedy panel burst through ONE batcher flipping "
+        "ContinuousConfig.decode_rounds between bursts — R=4 folds "
+        "four decode rounds (device-side stop scan, sampling, "
+        "emit/length bookkeeping, early-exit masking) into each "
+        "dispatched program so the host fetches once per window, R=1 "
+        "is today's one-round dispatch — byte-identical text REQUIRED "
+        "per pair, gates on device programs per generated token "
+        "dropping >= 3x at R=4 and on the PR-5 dual tok/s gate "
+        "(loadavg-aware escalation); reports rounds/program and "
+        "program-MBU sums per leg",
+    )
+    p.add_argument(
+        "--rounds-ab-rounds",
+        type=int,
+        default=2,
+        help="alternating R=1/R=4 paired rounds for "
+        "--serve-decode-rounds",
+    )
+    p.add_argument(
         "--serve-trace-overhead",
         action="store_true",
         help="observability A/B leg: the identical panel-shaped burst "
@@ -654,6 +676,8 @@ def main() -> int:
         return _bench_serving_spec_ab(args, cfg, params)
     if args.draft:
         return _bench_speculative(args, cfg, params, tokens, lengths)
+    if args.serve_decode_rounds:
+        return _bench_serving_rounds_ab(args, cfg, params)
     if args.serve_decode_pipeline:
         return _bench_serving_pipeline_ab(args, cfg, params)
     if args.serve_ragged_attention:
@@ -1821,6 +1845,166 @@ def _bench_serving_spec_ab(args, cfg, params) -> int:
             "not amortize; resize the leg",
             file=sys.stderr,
         )
+        return 1
+    return 0
+
+
+def _bench_serving_rounds_ab(args, cfg, params) -> int:
+    """Multi-round on-device decode A/B (PR 12): the same greedy panel
+    burst through ONE batcher flipping ``decode_rounds`` 1 <-> 4
+    between bursts. R=4 folds four decode rounds — device-side stop
+    scan, sampling, emit/length bookkeeping, early-exit masking — into
+    each dispatched program, so the host fetches once per window.
+
+    Gates (rc 1 on failure, mirrored in the JSON ``status``):
+    byte-identical text per R=1/R=4 pair; device programs per
+    generated token dropping >= 3x at R=4 (the dispatch-count win the
+    feature exists for — 4x minus the shared prefill/fused chunk
+    programs both legs pay); and the PR-5 dual tok/s gate with the
+    PR-10 loadavg-aware escalation (R=4 must not cost throughput on a
+    box whose dispatch is already cheap; on the chip it is the win).
+    """
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    pg = 64
+    R = 4
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    n = args.serve_requests
+    longest = header_target + 64
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    chunk = args.serve_prefill_chunk or 64
+    # Page budget: the R-round window replaces steps_per_sync as the
+    # per-program overshoot unit (_round_tokens reads the CONFIG R, so
+    # both legs run over the same reservation).
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], args.new_tokens, R, pg
+    )
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2
+    header = f"Panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    panel = [
+        header + f" Q{i}: item {i * 37 % 101}?" for i in range(n)
+    ]
+
+    batcher = ContinuousBatcher(
+        cfg,
+        params,
+        config=ContinuousConfig(
+            max_slots=args.serve_slots,
+            page_size=pg,
+            n_pages=n_pages,
+            pages_per_seq=pages_per_seq,
+            max_new_tokens=args.new_tokens,
+            seq_buckets=tuple(buckets),
+            steps_per_sync=1,
+            prefill_chunk=chunk,
+            share_prefix=True,
+            decode_rounds=R,
+        ),
+    )
+
+    texts_last: dict[bool, list[str]] = {}
+    ppt: dict[bool, list[float]] = {True: [], False: []}
+    mbu: dict[bool, list[float]] = {True: [], False: []}
+    diverged = False
+
+    _PROG_KEYS = tuple(
+        f"device_programs_{k}"
+        for k in ("fused", "decode", "prefill", "spec", "draft")
+    )
+
+    def leg(tag, rounds_on):
+        """One burst at R=4 (on) or R=1 (off); returns tok/s and
+        accumulates programs-per-token + modeled decode HBM rates."""
+        nonlocal diverged
+        batcher.config.decode_rounds = R if rounds_on else 1
+        _quiesce_batcher(batcher)
+        s0 = batcher.stats()
+        t0 = time.perf_counter()
+        futs = [
+            batcher.submit(p, max_new_tokens=args.new_tokens)
+            for p in panel
+        ]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        _quiesce_batcher(batcher)
+        s1 = batcher.stats()
+        toks = s1["generated_tokens"] - s0["generated_tokens"]
+        progs = sum(s1[k] - s0[k] for k in _PROG_KEYS)
+        ppt[rounds_on].append(progs / max(1, toks))
+        secs = s1["mbu_seconds_decode"] - s0["mbu_seconds_decode"]
+        mbu[rounds_on].append(
+            (s1["mbu_hbm_bytes_decode"] - s0["mbu_hbm_bytes_decode"])
+            / max(secs, 1e-9)
+        )
+        texts_last[rounds_on] = [r.text for r in results]
+        if len(texts_last) == 2 and texts_last[True] != texts_last[False]:
+            diverged = True
+        return sum(r.num_tokens for r in results) / wall
+
+    try:
+        # Warmup compiles both program families (legacy one-round,
+        # R-round masked scan, their fused chunk variants).
+        for on in (True, False):
+            batcher.config.decode_rounds = R if on else 1
+            futs = [
+                batcher.submit(
+                    header + f" warm {on} {i}",
+                    max_new_tokens=args.new_tokens,
+                )
+                for i in range(min(4, n))
+            ]
+            for f in futs:
+                f.result(timeout=600)
+        runs_off, runs_on = _ab_rounds(leg, args.rounds_ab_rounds)
+        _ab_escalate(leg, runs_off, runs_on, "decode-rounds")
+    finally:
+        batcher.close()
+
+    best_off = max(runs_off)
+    best_on = max(runs_on)
+    # Aggregate programs-per-token per leg (deterministic on an idle
+    # box; aggregating keeps one jittered round from gating).
+    ppt_off = sum(ppt[False]) / len(ppt[False])
+    ppt_on = sum(ppt[True]) / len(ppt[True])
+    drop = ppt_off / max(ppt_on, 1e-9)
+    tput_ok = _dual_gate_ok(runs_off, runs_on)
+    status = "ok"
+    if diverged:
+        status = "failed: text diverged between R=1 and R=4"
+    elif drop < 3.0:
+        status = (
+            f"failed: programs/token dropped only {drop:.2f}x (gate 3x)"
+        )
+    elif not tput_ok:
+        status = "failed: R=4 tok/s regressed past the dual gate"
+    _emit(
+        {
+            "metric": f"serving tok/s, multi-round decode ({cfg.name}, "
+            f"{len(runs_on)}x{n} panel reqs, slots={args.serve_slots}, "
+            f"R={R}, decode {args.new_tokens} @ ~{header_target} "
+            f"shared prompts, programs/token {ppt_off:.3f} -> "
+            f"{ppt_on:.3f} ({drop:.2f}x drop), modeled decode HBM "
+            f"{max(mbu[False]) / 1e9:.2f} -> "
+            f"{max(mbu[True]) / 1e9:.2f} GB/s, "
+            f"R=1 best {best_off:.0f} tok/s, "
+            f"text unchanged={not diverged})",
+            "value": round(best_on, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(best_on / max(best_off, 1e-9), 4),
+            "status": status,
+        },
+        args.out,
+    )
+    if status != "ok":
+        print(f"[bench] decode-rounds leg: {status}", file=sys.stderr)
         return 1
     return 0
 

@@ -182,6 +182,7 @@ def _build_backend(args):
                 pipeline_depth=args.pipeline_depth,
                 ragged_attention=not args.no_ragged_attention,
                 spec_k=args.spec_k if draft is not None else 0,
+                decode_rounds=args.decode_rounds,
                 hbm_gbps=args.hbm_gbps,
             ),
             mesh=mesh,
@@ -253,6 +254,20 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "the host loop enqueues program n+1 before fetching program "
         "n's tokens, hiding scheduling work behind device compute "
         "(1 = the serialized loop; outputs are identical either way)",
+    )
+    p.add_argument(
+        "--decode-rounds",
+        type=int,
+        default=1,
+        help="continuous backend: decode rounds folded into one "
+        "device program (PR 12) — stop scan, sampling, and emit/"
+        "length bookkeeping run on device and a row hitting a stop "
+        "or its token budget mid-window freezes (no further KV "
+        "writes or PRNG folds) while neighbors keep decoding; the "
+        "host fetches once per R rounds. Text is byte-identical to "
+        "1 (the default); engages off-mesh with steps-per-sync 1, "
+        "and requests whose stop sequences have no bounded device "
+        "screen collapse the window to 1 while they decode",
     )
     p.add_argument(
         "--hbm-gbps",

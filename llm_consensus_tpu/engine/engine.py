@@ -382,15 +382,15 @@ class InferenceEngine:
         return KVCache(k=s5, v=s5, length=ln)
 
     def _stop_ids(self, stop: list[str] | None) -> tuple[int, ...]:
-        """Stops that tokenize to exactly one id terminate on device."""
+        """Stops that tokenize to exactly one id terminate on device —
+        the single-round path's share of the derived-stop machinery in
+        :mod:`llm_consensus_tpu.utils.stops` (the multi-round batcher's
+        conservative screen lives next to it)."""
         if not stop:
             return ()
-        ids = []
-        for s in stop:
-            enc = self.tokenizer.encode(s, add_bos=False)
-            if len(enc) == 1:
-                ids.append(enc[0])
-        return tuple(dict.fromkeys(ids))
+        from llm_consensus_tpu.utils.stops import single_token_stop_ids
+
+        return single_token_stop_ids(self.tokenizer, stop)
 
     @staticmethod
     def _trim_stops(results: list[EngineResult], stop: list[str] | None):

@@ -189,3 +189,34 @@ def sample_token_per_request(
     tok = jnp.where(temperature > 0, sampled, greedy_tok)
     logprobs_full = jax.nn.log_softmax(scaled, axis=-1)
     return tok, logprobs_full[jnp.arange(b), tok]
+
+
+def stop_scan_hit(
+    next_tok: jnp.ndarray,
+    eos_id: int,
+    screen: jnp.ndarray,
+    emitted: jnp.ndarray,
+    budgets: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-row ON-DEVICE stop scan for one multi-round decode round
+    (PR 12) — the freeze predicate the batcher's scan body applies
+    after sampling each round's token.
+
+    next_tok/emitted/budgets: [B] (the just-sampled token, tokens
+    emitted so far in this window INCLUDING it, and the row's
+    remaining max-new-tokens budget at dispatch); screen: [B, W] int32
+    candidate stop-completing ids per row, -1-padded (the conservative
+    :func:`llm_consensus_tpu.utils.stops.derived_stop_screen` — a hit
+    is a candidate the host's byte-level check confirms at fetch, so
+    over-firing costs rounds, never text). Returns [B] bool: True
+    where the row must FREEZE — EOS (exact), a screened candidate
+    (conservative), or the max-tokens budget reached (exact at
+    pipeline depth 1, an upper bound under retirement lag — the host
+    trim discards overshoot either way). EOS and the budget are the
+    same rules the host applies per fetched token; keeping all three
+    in one predicate is what lets R rounds run between host looks
+    without changing what a request observes.
+    """
+    hit = next_tok == jnp.int32(eos_id)
+    hit = hit | jnp.any(screen == next_tok[:, None], axis=1)
+    return hit | (emitted >= budgets)

@@ -332,7 +332,13 @@ def program_hbm_cost(
     A MODEL, not a measurement: activation traffic, index/table reads,
     and padding rows are excluded; on a chip whose decode programs are
     truly bandwidth-bound the modeled bytes are the dominant term and
-    MBU lands near 1.0.
+    MBU lands near 1.0. Multi-round programs (PR 12) are R rounds of
+    KV growth under ONE weight read: the caller passes the summed
+    per-round reads (``k*L + k*(k-1)/2`` per row at committed length
+    L) and ``k`` writes/tokens per row, so amortization shows up as
+    hbm_bytes growing sublinearly in k while tokens grow linearly —
+    rows frozen by early-exit masking make the passed counts an upper
+    bound, exactly like padding rows make the weight term a floor.
     """
     hbm_bytes = int(
         weight_bytes + (kv_read_tokens + kv_write_tokens) * kv_token_bytes
@@ -1134,6 +1140,7 @@ def decode_step_paged(
     tokens: jnp.ndarray,
     cache,
     groups=None,
+    write_mask=None,
 ) -> tuple[jnp.ndarray, object]:
     """One decode step for every cache sequence, paged layout.
 
@@ -1154,8 +1161,19 @@ def decode_step_paged(
     window is per-row masking in the same kernel — the old fallback is
     gone). The jnp gather path ignores ``groups`` (outputs are
     identical either way — the callers' parity contract).
+
+    ``write_mask`` ([max_seqs] bool or None): device-side early-exit
+    masking for multi-round decode (PR 12). A False row is FROZEN: its
+    K/V write is redirected into the reserved NULL page (the same sink
+    inactive rows already decode into), its ``length`` does not
+    advance, and its attention reads stay bounded by the unchanged
+    length — so a row that hit a stop inside a multi-round window
+    leaves zero trace in its real pages while its batch neighbors keep
+    decoding. Frozen rows still flow through the matmuls (SIMD rows
+    are not skippable); their logits are garbage the caller discards.
+    None (default) = every row live, exactly the pre-PR-12 step.
     """
-    from llm_consensus_tpu.models.paged_cache import PagedKVCache
+    from llm_consensus_tpu.models.paged_cache import NULL_PAGE, PagedKVCache
 
     b = tokens.shape[0]
     pos = cache.length  # [B] current write position
@@ -1166,6 +1184,11 @@ def decode_step_paged(
     pg = cache.page_size
     pages_now = cache.page_table[jnp.arange(b), pos // pg]  # [B]
     offset = pos % pg
+    if write_mask is None:
+        adv = 1
+    else:
+        pages_now = jnp.where(write_mask, pages_now, NULL_PAGE)
+        adv = write_mask.astype(pos.dtype)
     tables = cache.page_table  # [B, P]
 
     def body(carry, layer_in):
@@ -1177,7 +1200,7 @@ def decode_step_paged(
         k_pool = k_pool.at[pages_now, offset].set(k[:, 0].astype(k_pool.dtype))
         v_pool = v_pool.at[pages_now, offset].set(v[:, 0].astype(v_pool.dtype))
         attn = _attn_paged(
-            cfg, q[:, 0], None, k_pool, v_pool, tables, pos + 1,
+            cfg, q[:, 0], None, k_pool, v_pool, tables, pos + adv,
             groups=groups,
         )[:, None]  # [B, H, D] -> [B, 1, H, D] (seq axis restored)
         y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
@@ -1190,7 +1213,7 @@ def decode_step_paged(
     )
     logits = _unembed(cfg, params, x[:, 0])
     new_cache = PagedKVCache(
-        k=new_k, v=new_v, page_table=cache.page_table, length=pos + 1
+        k=new_k, v=new_v, page_table=cache.page_table, length=pos + adv
     )
     return logits, new_cache
 

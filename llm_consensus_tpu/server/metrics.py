@@ -577,6 +577,30 @@ RAGGED_ROWS = REGISTRY.histogram(
     "Rows (decode rows + fused prefill-chunk lanes) per device program",
     buckets=OCCUPANCY_BUCKETS,
 )
+#: Multi-round on-device decode (PR 12): decode rounds folded into one
+#: dispatched program. A plain decode/fused program under
+#: ``ContinuousConfig.decode_rounds`` R runs up to R decode rounds —
+#: stop scan, sampling, emit-count/length bookkeeping on device, frozen
+#: rows masked — before the host fetches; a speculative verify round
+#: counts 1 (its emit is already multi-token). Rounds count once per
+#: PROGRAM, not per row: ``device_rounds_total`` over the
+#: decode-advancing ``gateway_device_programs_total`` is the realized
+#: rounds per program (→ R when multi-round engages), and device
+#: programs per generated token drops ~R× at R for a fixed batch
+#: shape (its absolute value carries the 1/batch-rows factor) — the
+#: cross-check the bench A/B leg gates. Histogram: the per-program
+#: round count at dispatch (R, or 1 when a row's stop sequences have
+#: no bounded device screen and the window collapses to the
+#: host-checked cadence).
+DECODE_ROUNDS_PER_PROGRAM = REGISTRY.histogram(
+    "gateway_decode_rounds_per_program",
+    "Decode rounds folded into one dispatched device program",
+    buckets=OCCUPANCY_BUCKETS,
+)
+DEVICE_ROUNDS = REGISTRY.counter(
+    "gateway_device_rounds_total",
+    "Decode rounds dispatched across all decode-advancing device programs",
+)
 #: Speculative decoding inside the continuous batcher (PR 9). The
 #: draft proposes ``spec_k`` tokens per round — ONE stream per
 #: shared-prefix panel group (mates whose committed text still agrees

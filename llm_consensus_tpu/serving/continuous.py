@@ -89,10 +89,12 @@ from llm_consensus_tpu.engine.engine import _next_bucket
 from llm_consensus_tpu.engine.sampler import (
     SamplerConfig,
     sample_token_per_request,
+    stop_scan_hit,
 )
 from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
 from llm_consensus_tpu.utils.stops import (
     VisibleIdFilter,
+    derived_stop_screen,
     earliest_stop_cut,
     stop_tail_window,
 )
@@ -180,6 +182,12 @@ from llm_consensus_tpu.server.metrics import (
     RAGGED_ROWS as _M_RAGGED_ROWS,
 )
 from llm_consensus_tpu.server.metrics import (
+    DECODE_ROUNDS_PER_PROGRAM as _M_DECODE_ROUNDS,
+)
+from llm_consensus_tpu.server.metrics import (
+    DEVICE_ROUNDS as _M_DEVICE_ROUNDS,
+)
+from llm_consensus_tpu.server.metrics import (
     SPEC_DRAFT_TOKENS as _M_SPEC_DRAFTED,
 )
 from llm_consensus_tpu.server.metrics import (
@@ -225,6 +233,18 @@ log = logging.getLogger(__name__)
 # Process-wide request-id stream: ids key the (process-global)
 # RequestLog, so two batchers in one process must not collide.
 _RID = itertools.count(1)
+
+# Width of the per-row device stop screen (PR 12): a request's derived
+# candidate-id set rides the multi-round program as one -1-padded
+# [max_slots, _SCREEN_W] data row. STATIC — widening it per request
+# would make screen size a compiled shape. Requests whose screen
+# doesn't fit bound the window to 1 round instead (derived_stop_screen
+# returns None past the cap).
+_SCREEN_W = 8
+
+# Bound on the per-batcher derived-screen memo (stop tuples are
+# client-supplied; see _screen_cache).
+_SCREEN_CACHE_MAX = 512
 
 
 @dataclass
@@ -347,6 +367,33 @@ class ContinuousConfig:
     # dispatch pipeline so plain and spec programs never share a
     # window). No effect without spec_k > 0 + a draft model.
     spec_decode: bool = True
+    # Multi-round on-device decode (PR 12, ``serve --decode-rounds``):
+    # decode rounds per dispatched device program. R > 1 folds up to R
+    # decode rounds into ONE program (lax.scan over the shared decode
+    # body) with stop checking, sampling, and per-row emit-count /
+    # cache-length bookkeeping fully on device: a row that samples EOS,
+    # a screened stop-candidate token, or its max-tokens budget inside
+    # the window FREEZES (K/V writes redirected to the NULL page, PRNG
+    # folds stop, length stops advancing) while its neighbors keep
+    # decoding — the host fetches once per R rounds and retires /
+    # regroups from the lagged mirror, exactly the PR-9 spec-verify
+    # pattern. Text is byte-identical to R = 1: EOS and max-tokens are
+    # exact on device; stop SEQUENCES freeze conservatively via the
+    # derived byte screen (utils.stops.derived_stop_screen) and the
+    # host's byte-level check at fetch stays authoritative (a false
+    # positive resumes next window; a miss is trimmed on fetch) — and
+    # a request whose stops admit no bounded screen collapses the
+    # window to 1 round while it decodes. Engages off-mesh with
+    # steps_per_sync == 1 (the legacy multi-step chunk has no masking
+    # and stays the tunnel-RTT knob); while speculation is engaged the
+    # verify round IS the multi-token step, so spec windows keep one
+    # verify round per dispatch and multi-round applies to the plain
+    # windows — the two compose by decoupling fetch cadence from the
+    # verify round, and flips drain the pipeline like every mode
+    # change. Sizes the page-overshoot budget of every admission like
+    # spec_k does, so treat live flips as between-bursts events (the
+    # bench's A/B lever). 1 (default) = today's one-round dispatch.
+    decode_rounds: int = 1
     # Roofline attribution (PR 10): the device's peak HBM bandwidth in
     # GB/s (1e9 bytes/s — e.g. ~819 for a v5e, ~1640 for a v5p core).
     # > 0: every fetched device program sets
@@ -398,6 +445,12 @@ class _Request:
     # re-encoding them per sampled token would put tokenizer calls on
     # the thread pacing device steps).
     stop_window: int = 0
+    # Device stop screen for multi-round decode (PR 12), derived once
+    # at submit (memoized per stop tuple): () = no stops (never screen-
+    # freezes), a tuple of <= _SCREEN_W candidate ids, or None = stops
+    # with no bounded screen — this row bounds any multi-round window
+    # it rides to 1 round (host-checked cadence).
+    stop_screen: tuple[int, ...] | None = ()
     # Request-scoped trace captured from the submitter's context: the
     # worker thread attaches prefill-chunk/decode-step/restore spans to
     # it explicitly (contextvars do not cross the thread boundary).
@@ -505,6 +558,14 @@ class _Inflight:
     spec_k: int = 0
     emit_cnt: object = None  # device [slots] emitted-token counts
     counts_out: object = None  # device [slots] post-round PRNG counts
+    # -- multi-round decode (PR 12) --------------------------------------
+    # > 0: this program ran through the multi-round machinery (that
+    # many masked decode rounds — possibly 1 when a stop-bound
+    # collapsed the window); its per-row yield is data-dependent like
+    # a spec round's (``emit_cnt`` leading tokens real, ``counts_out``
+    # device-resident, host count/draft-lag mirrors sync at fetch).
+    # 0: a legacy program whose host mirrors advanced at dispatch.
+    rounds: int = 0
     # -- flight recorder + roofline attribution (PR 10) -----------------
     # The "program" flight event recorded at dispatch: the fetch fills
     # its (t0, dur) window in place once the true device window is
@@ -604,6 +665,21 @@ class ContinuousBatcher:
                 v=NamedSharding(mesh, P(None, "data", None, "model", None)),
                 page_table=NamedSharding(mesh, P("data", None)),
                 length=NamedSharding(mesh, P("data")),
+            )
+        if c.decode_rounds > 1 and (c.steps_per_sync > 1 or mesh is not None):
+            # Not an error (the batcher serves correctly either way),
+            # but the config still pays decode_rounds into every
+            # admission's page-overshoot budget (_round_tokens reads
+            # the CONFIG so live flips stay budgeted) while _rounds
+            # never engages — capacity spent for zero benefit needs a
+            # signal, exactly like the spec warning above.
+            log.warning(
+                "decode_rounds=%d never engages with steps_per_sync=%d"
+                "%s: no multi-round program will dispatch, but the "
+                "page-overshoot budget still reserves for R rounds",
+                c.decode_rounds,
+                c.steps_per_sync,
+                " on a mesh" if mesh is not None else "",
             )
         self.cache = PagedKVCache.create(
             cfg, c.n_pages, c.page_size, c.max_slots, c.pages_per_seq
@@ -750,6 +826,13 @@ class ContinuousBatcher:
         self._ragged_rows_sum = 0
         self._ragged_rows_count = 0
         self._work_iterations = 0
+        # Multi-round decode (PR 12): total decode rounds dispatched
+        # and the per-program round-count observations — the same
+        # numbers behind gateway_device_rounds_total /
+        # gateway_decode_rounds_per_program (lockstep tested).
+        self._device_rounds = 0
+        self._decode_rounds_sum = 0
+        self._decode_rounds_count = 0
         # perf_counter stamp of the previous fetch's completion: deeper
         # than depth 1 a program starts on device when its predecessor
         # finishes, not at its own dispatch — the step histogram uses
@@ -799,6 +882,21 @@ class ContinuousBatcher:
         self._jit_decode = jax.jit(
             self._decode_sample, donate_argnums=(1,), static_argnums=(8,)
         )
+        # Multi-round decode program (PR 12): rounds is static (the
+        # scan length; two cached traces per variant — R, and the
+        # stop-bound 1), filters_active as in _jit_decode.
+        self._jit_rounds = jax.jit(
+            self._rounds_sample, donate_argnums=(2,), static_argnums=(0, 9)
+        )
+        # Derived stop screens memoized per stop tuple: the derivation
+        # scans the vocabulary once, and submit() runs on caller
+        # threads that must not repay it per request. BOUNDED
+        # (evict-oldest past _SCREEN_CACHE_MAX) like every other
+        # long-lived store here — stop tuples are client-supplied, so
+        # an unbounded memo is a slow leak under per-request-unique
+        # stops; a cycling adversary re-pays only the capped
+        # (max_vocab_scan decodes) derivation on its own thread.
+        self._screen_cache: dict[tuple, tuple[int, ...] | None] = {}
         self._jit_prefill = {}
         self._jit_chunk = {}  # (chunk, s_bucket) -> compiled chunk prefill
         self._jit_fused = {}  # (chunk, s_bucket) -> compiled fused step
@@ -868,13 +966,34 @@ class ContinuousBatcher:
         )
 
     @property
+    def _rounds(self) -> int:
+        """Decode rounds folded into one PLAIN (non-spec) dispatch
+        (PR 12) — ``decode_rounds`` when engaged, else 1. Engages
+        off-mesh with steps_per_sync == 1: the legacy multi-step chunk
+        is unmasked (and the mesh path would scatter frozen rows'
+        NULL-page writes across the data axis — open item 1's sharding
+        refactor). Read per loop iteration (the bench's A/B lever);
+        while > 1 every non-spec dispatch runs the multi-round
+        machinery — even a stop-bound 1-round window — so a pipeline
+        window never mixes host- and device-advanced PRNG counts."""
+        c = self.config
+        if (
+            c.decode_rounds <= 1
+            or self._sync_chunk != 1
+            or self.mesh is not None
+        ):
+            return 1
+        return c.decode_rounds
+
+    @property
     def _round_tokens(self) -> int:
         """Worst-case tokens ONE dispatched program advances a row by —
         the page-overshoot unit. Plain decode: the steps_per_sync
-        chunk. With a draft configured: spec_k + 1 verify tokens,
-        counted REGARDLESS of the live spec_decode flip so in-flight
-        admissions stay budgeted across a flip."""
-        rt = self._sync_chunk
+        chunk, or the decode_rounds window (PR 12) — counted from the
+        CONFIG regardless of live engagement, exactly like spec_k, so
+        in-flight admissions stay budgeted across a flip. With a draft
+        configured: spec_k + 1 verify tokens."""
+        rt = max(self._sync_chunk, self.config.decode_rounds)
         if self._draft_cfg is not None:
             rt = max(rt, self.config.spec_k + 1)
         return rt
@@ -919,15 +1038,43 @@ class ContinuousBatcher:
         return toks.T, logps.T, cache, tok_end
 
     def _decode_body(
-        self, params, seeds, temps, topks, topps, filters_active, groups
+        self,
+        params,
+        seeds,
+        temps,
+        topks,
+        topps,
+        filters_active,
+        groups,
+        stop=None,
     ):
-        """One decode+sample step as a scan body — shared by the plain
-        and the fused program so the two paths cannot drift."""
+        """One decode+sample step as a scan body — shared by the plain,
+        the fused, AND the multi-round program so the paths cannot
+        drift.
+
+        ``stop`` (PR 12): None = the classic body (every row live,
+        carry ``(cache, tok, cnt)``). A ``(budgets, screen)`` pair =
+        the early-exit-masked body — carry grows to ``(cache, tok,
+        cnt, alive, emitted)``; a live row decodes exactly the classic
+        step (same K/V write, same (seed, count) PRNG fold, same
+        sampler), then :func:`stop_scan_hit` freezes it on EOS, a
+        screened stop candidate, or its emit budget. A frozen row
+        stops writing K/V (decode_step_paged's write_mask), stops
+        folding its PRNG (count invariance vs R = 1), holds its last
+        token (the emit buffer past ``emitted`` is that stale token —
+        the host reads only the real prefix), and stays frozen for the
+        window's remainder (freezing is monotone, so the real tokens
+        are always a prefix)."""
 
         def body(carry, _):
-            cache, tok, cnt = carry
+            if stop is None:
+                cache, tok, cnt = carry
+                alive = None
+            else:
+                cache, tok, cnt, alive, emitted = carry
             logits, cache = decode_step_paged(
-                self.cfg, params, tok[:, None], cache, groups=groups
+                self.cfg, params, tok[:, None], cache, groups=groups,
+                write_mask=alive,
             )
             keys = jax.vmap(
                 lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
@@ -940,9 +1087,67 @@ class ContinuousBatcher:
                 logits, keys, temps, topks, topps,
                 filters_active=filters_active,
             )
-            return (cache, next_tok, cnt + 1), (next_tok, logp)
+            if stop is None:
+                return (cache, next_tok, cnt + 1), (next_tok, logp)
+            budgets, screen = stop
+            next_tok = jnp.where(alive, next_tok, tok)
+            adv = alive.astype(cnt.dtype)
+            cnt = cnt + adv
+            emitted = emitted + adv
+            hit = stop_scan_hit(
+                next_tok, self.tokenizer.eos_id, screen, emitted, budgets
+            )
+            alive = alive & ~hit
+            return (cache, next_tok, cnt, alive, emitted), (next_tok, logp)
 
         return body
+
+    def _rounds_sample(
+        self,
+        rounds,
+        params,
+        cache,
+        tokens,
+        seeds,
+        counts,
+        temps,
+        topks,
+        topps,
+        filters_active,
+        budgets,
+        screen,
+        groups=None,
+    ):
+        """Up to ``rounds`` decode rounds as ONE device program (PR 12)
+        — the multi-round counterpart of :meth:`_decode_sample`, built
+        on the same scan body with the early-exit mask threaded
+        through the carry.
+
+        counts: [B] device-resident per-row PRNG indices (the yield is
+        data-dependent once rows can freeze mid-window, so counts
+        thread program-to-program like the spec path's — the host
+        mirror syncs at fetch); budgets: [B] max tokens each row may
+        emit this window (its remaining max-new-tokens at dispatch);
+        screen: [B, _SCREEN_W] -1-padded candidate stop ids. Every row
+        enters alive, so each dispatched row emits >= 1 token — the
+        invariant ``next_in`` (the final carry token, held through
+        frozen rounds) relies on.
+
+        Returns ``(emit [B, R], logps [B, R], cache, next_in [B],
+        counts_out [B], emit_cnt [B])`` — only each row's leading
+        ``emit_cnt`` tokens are real, the spec program's contract.
+        """
+        alive0 = jnp.ones(tokens.shape, dtype=bool)
+        emitted0 = jnp.zeros_like(counts)
+        body = self._decode_body(
+            params, seeds, temps, topks, topps, filters_active, groups,
+            stop=(budgets, screen),
+        )
+        (cache, tok_end, cnt_out, _, emitted), (toks, logps) = jax.lax.scan(
+            body, (cache, tokens, counts, alive0, emitted0), None,
+            length=rounds,
+        )
+        return toks.T, logps.T, cache, tok_end, cnt_out, emitted
 
     def _fused_sample(
         self,
@@ -962,9 +1167,22 @@ class ContinuousBatcher:
         chunk_start,
         chunk_last,
         chunk_done,
+        stop_rounds=0,
+        budgets=None,
+        screen=None,
     ):
         """The fused scheduler step: ``steps_per_sync`` decode+sample
         steps AND one prefill chunk as ONE device program (PR 8).
+
+        ``stop_rounds`` (STATIC, PR 12): > 0 makes this the MULTI-ROUND
+        fused step — the chunk rides round 1 exactly as before (every
+        row enters alive, so the first step needs no mask), then
+        ``stop_rounds - 1`` early-exit-masked rounds follow via the
+        shared stop body, and the returns grow by ``(emit_cnt,
+        counts_out)`` with only each row's leading ``emit_cnt`` emit
+        tokens real — the chunk keeps riding the decode dispatch under
+        ``decode_rounds`` without a pipeline flush per admission.
+        0 = the PR-8 behavior and return shape, byte-for-byte.
 
         The chunk rides the FIRST decode step's layer pass
         (:func:`~llm_consensus_tpu.models.transformer.fused_step_paged`
@@ -1005,6 +1223,40 @@ class ContinuousBatcher:
                 0, jnp.clip(chunk_last - chunk_start, 0, c - 1)
             ]
             chunk_logits = unembed_one(self.cfg, params, h_last)
+        if stop_rounds:
+            # Multi-round tail (PR 12): round 1 was the fused step
+            # above (all rows alive by the dispatch invariant); apply
+            # its freeze decision, then scan the masked body for the
+            # window's remainder. Same (seed, count + j) folds as
+            # _rounds_sample — the chunk lane never perturbs a decode
+            # row's PRNG stream.
+            emitted = jnp.ones_like(counts)
+            alive = ~stop_scan_hit(
+                tok1, self.tokenizer.eos_id, screen, emitted, budgets
+            )
+            if stop_rounds > 1:
+                body = self._decode_body(
+                    params, seeds, temps, topks, topps, filters_active,
+                    groups, stop=(budgets, screen),
+                )
+                (cache, tok_end, cnt_out, _, emitted), (toks, logps) = (
+                    jax.lax.scan(
+                        body,
+                        (cache, tok1, counts + 1, alive, emitted),
+                        None,
+                        length=stop_rounds - 1,
+                    )
+                )
+                toks = jnp.concatenate([tok1[:, None], toks.T], axis=1)
+                logps = jnp.concatenate([logp1[:, None], logps.T], axis=1)
+                return (
+                    toks, logps, cache, tok_end, chunk_logits, emitted,
+                    cnt_out,
+                )
+            return (
+                tok1[:, None], logp1[:, None], cache, tok1, chunk_logits,
+                emitted, counts + 1,
+            )
         if k > 1:
             body = self._decode_body(
                 params, seeds, temps, topks, topps, filters_active, groups
@@ -1389,7 +1641,7 @@ class ContinuousBatcher:
             self._jit_fused[key] = jax.jit(
                 partial(self._fused_sample, cfg_chunk),
                 donate_argnums=(1,),
-                static_argnums=(8, 14),
+                static_argnums=(8, 14, 15),
             )
         return self._jit_fused[key]
 
@@ -1457,6 +1709,21 @@ class ContinuousBatcher:
         dflt = c.sampler or SamplerConfig()
         stop = tuple(stop or ())
         window = stop_tail_window(self.tokenizer, stop)
+        # Multi-round decode (PR 12): the device stop screen, derived
+        # once per distinct stop tuple (the derivation scans the
+        # vocabulary; this thread must not repay it per request).
+        if stop in self._screen_cache:
+            screen = self._screen_cache[stop]
+        else:
+            screen = derived_stop_screen(
+                self.tokenizer, stop, max_ids=_SCREEN_W
+            )
+            with self._lock:
+                while len(self._screen_cache) >= _SCREEN_CACHE_MAX:
+                    self._screen_cache.pop(
+                        next(iter(self._screen_cache))
+                    )
+                self._screen_cache[stop] = screen
         req = _Request(
             prompt_ids=ids,
             max_new_tokens=max_new_tokens,
@@ -1467,6 +1734,7 @@ class ContinuousBatcher:
             top_p=dflt.top_p if top_p is None else top_p,
             stop=stop,
             stop_window=window,
+            stop_screen=screen,
             trace=_tracing.current_trace(),
             rid=f"req-{next(_RID)}",
             t_submit=time.perf_counter(),
@@ -1583,6 +1851,20 @@ class ContinuousBatcher:
                 "ragged_rows_sum": self._ragged_rows_sum,
                 "ragged_rows_count": self._ragged_rows_count,
                 "work_iterations": self._work_iterations,
+                # Multi-round on-device decode (PR 12) — the same
+                # observations behind gateway_device_rounds_total /
+                # gateway_decode_rounds_per_program (lockstep tested):
+                # total decode rounds dispatched, and the histogram's
+                # sum/count over decode-advancing programs
+                # (decode/fused pass their window, spec passes 1 —
+                # rounds count once per PROGRAM, not per row).
+                # device_rounds_total / decode_rounds_count is the
+                # realized rounds per program, and device programs per
+                # generated token drops ~R× at R for a fixed batch
+                # shape — the cross-check the bench leg gates.
+                "device_rounds_total": self._device_rounds,
+                "decode_rounds_sum": self._decode_rounds_sum,
+                "decode_rounds_count": self._decode_rounds_count,
                 # Speculative decoding (PR 9) — the same observations
                 # behind gateway_spec_draft_tokens_total /
                 # gateway_spec_accepted_tokens_total /
@@ -2097,26 +2379,49 @@ class ContinuousBatcher:
             self._offload_restored += 1
         return True
 
-    def _count_program(self, kind: str, rows: int | None = None):
+    def _count_program(
+        self,
+        kind: str,
+        rows: int | None = None,
+        rounds: int | None = None,
+    ):
         """One device program dispatched by the scheduler loop: feed
         the Prometheus families, the stats() mirrors, AND the flight
         recorder from the same site (lockstep — the Chrome export's
         device track reconstructs exactly the programs this counted).
         ``rows``: ragged-row occupancy for fused/decode programs
-        (decode rows + chunk lanes). Returns the flight event (None
-        when recording is off) so pipelined callers can fill in the
-        true device window in place once the fetch lands."""
+        (decode rows + chunk lanes). ``rounds`` (PR 12): decode rounds
+        this program folds — decode/fused pass their window (R under
+        decode_rounds, steps_per_sync on the legacy chunk), spec
+        passes 1 (the verify round IS the multi-token step), prefill/
+        draft pass None (they advance no decode row) — feeding
+        gateway_device_rounds_total + the per-program histogram and
+        riding the PROGRAM flight event's meta so the Chrome export's
+        device track stays count-exact at R > 1 (one slice still means
+        one program, its ``rounds`` arg says how much decoding it
+        held). Returns the flight event (None when recording is off)
+        so pipelined callers can fill in the true device window in
+        place once the fetch lands."""
         _M_DEVICE_PROGRAMS.labels(kind=kind).inc()
         with self._lock:
             self._programs[kind] += 1
             if rows is not None:
                 self._ragged_rows_sum += rows
                 self._ragged_rows_count += 1
+            if rounds is not None:
+                self._device_rounds += rounds
+                self._decode_rounds_sum += rounds
+                self._decode_rounds_count += 1
         if rows is not None:
             _M_RAGGED_ROWS.observe(rows)
+        if rounds is not None:
+            _M_DEVICE_ROUNDS.inc(rounds)
+            _M_DECODE_ROUNDS.observe(rounds)
         meta = {"kind": kind}
         if rows is not None:
             meta["rows"] = rows
+        if rounds is not None:
+            meta["rounds"] = rounds
         if kind == "draft":
             # Draft mirror programs are dispatched async and never
             # individually fetched (their completion is implied by
@@ -2650,8 +2955,60 @@ class ContinuousBatcher:
                 )
             )
 
+    def _stop_plan(
+        self, rows_now: list, R: int
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Per-row device stop data for ONE multi-round dispatch
+        (PR 12): emit budgets (each row's remaining max-new-tokens in
+        the HOST mirror — exact at depth 1, an over-allowance under
+        retirement lag, where the fetch's host trim discards the
+        overshoot exactly as it always has), the -1-padded
+        [max_slots, _SCREEN_W] stop-candidate screen, and the window's
+        effective round count: R, or 1 when any decoding row's stop
+        sequences admit no bounded screen — those stops need the
+        host's byte-level look at every token, so the window collapses
+        to the pre-PR-12 cadence until the row retires (stop sequences
+        BOUND R; they never break text parity either way)."""
+        c = self.config
+        budgets = np.full((c.max_slots,), R, np.int32)
+        screen = np.full((c.max_slots, _SCREEN_W), -1, np.int32)
+        r_eff = R
+        for i, s in rows_now:
+            budgets[i] = max(
+                1, s.request.max_new_tokens - len(s.generated)
+            )
+            scr = s.request.stop_screen
+            if scr is None:
+                r_eff = 1
+            elif scr:
+                screen[i, : len(scr)] = scr
+        return budgets, screen, r_eff
+
+    def _counts_device_arg(self, dirty_np, rows):
+        """Device-resident PRNG-count input for a data-dependent
+        dispatch (spec or multi-round): the previous program's
+        ``counts_out`` with (re)activated rows patched from the host
+        mirror exactly like their input token, or the mirror itself
+        over an empty window. ONE copy for both branches — this is
+        race-sensitive bookkeeping (the snapshot rule of ``rows()``),
+        and the two callers drifting is how the PR-8 class of bug
+        comes back."""
+        if self._inflight:
+            counts_dev = self._inflight[-1].counts_out
+            if dirty_np.any():
+                counts_dev = jnp.where(
+                    jnp.asarray(dirty_np),
+                    jnp.asarray(np.array(self._counts)),
+                    counts_dev,
+                )
+            return counts_dev
+        return rows(self._counts)
+
     def _dispatch(
-        self, chunk_idx: int | None = None, spec: bool = False
+        self,
+        chunk_idx: int | None = None,
+        spec: bool = False,
+        rounds: int = 1,
     ) -> None:
         """Enqueue ONE decode program for the current decode batch.
 
@@ -2681,6 +3038,15 @@ class ContinuousBatcher:
         thread device-resident program-to-program (the host mirror
         syncs at fetch). Mutually exclusive with ``chunk_idx`` —
         chunks run standalone while speculation is engaged.
+
+        ``rounds`` (PR 12): the multi-round engage state from _run's
+        once-per-iteration read (1 = legacy single-round; _run passes
+        1 whenever ``spec`` is set). > 1 dispatches the R-round masked
+        program — :meth:`_rounds_sample`, or the fused step's
+        multi-round tail when a chunk rides — with the same
+        device-resident count threading as a spec round; the
+        per-dispatch effective window may still collapse to 1
+        (:meth:`_stop_plan`) without leaving the rounds counts-mode.
         """
         c = self.config
         k = self._sync_chunk
@@ -2760,16 +3126,7 @@ class ContinuousBatcher:
             # host mirror exactly like their input token. A mode flip
             # drains the pipeline first (_run), so a spec window only
             # ever chains spec outputs.
-            if self._inflight:
-                counts_dev = self._inflight[-1].counts_out
-                if dirty_np.any():
-                    counts_dev = jnp.where(
-                        jnp.asarray(dirty_np),
-                        jnp.asarray(np.array(self._counts)),
-                        counts_dev,
-                    )
-            else:
-                counts_dev = rows(self._counts)
+            counts_dev = self._counts_device_arg(dirty_np, rows)
             src, fill, off, streams, shared = self._spec_stream_plan(
                 rows_now
             )
@@ -2812,7 +3169,10 @@ class ContinuousBatcher:
                     rows(off),
                 )
             )
-            ev = self._count_program("spec", rows=len(rows_now))
+            # rounds=1: the verify round IS the multi-token step — one
+            # decode-advancing round per spec program (the
+            # device-rounds algebra the decode_rounds leg gates on).
+            ev = self._count_program("spec", rows=len(rows_now), rounds=1)
             cost = self._program_cost(
                 "spec", rows_now, c.spec_k, streams=streams
             )
@@ -2837,12 +3197,35 @@ class ContinuousBatcher:
                 cost=cost,
             )
             return self._dispatch_tail(rec, groups, k)
+        # Multi-round window (PR 12): like the spec branch, the yield
+        # is data-dependent once rows can freeze mid-window, so PRNG
+        # counts thread device-resident program-to-program (host
+        # mirror syncs at fetch), with (re)activated rows patched from
+        # the mirror exactly like their input token. A window only
+        # ever chains programs of one mode (_run drains on change) —
+        # ``rounds`` is threaded from _run's one read of the engage
+        # state per iteration, exactly like ``spec``, so a live
+        # config flip between the mode check and this dispatch cannot
+        # split the two decisions.
+        R = rounds
+        rounds_now = 0
+        counts_arg = None
+        budgets_dev = screen_dev = None
+        emit_cnt = cnt_out = None
+        if R > 1:
+            counts_arg = self._counts_device_arg(dirty_np, rows)
+            budgets_np, screen_np, rounds_now = self._stop_plan(rows_now, R)
+            budgets_dev = jnp.asarray(budgets_np)
+            screen_dev = jnp.asarray(screen_np)
+            k = rounds_now
+        else:
+            counts_arg = rows(self._counts)
         args = (
             self.params,
             self.cache,
             tokens,
             rows(self._seeds),
-            rows(self._counts),
+            counts_arg,
             rows(temps),
             rows(self._topks),
             rows(self._topps),
@@ -2851,8 +3234,21 @@ class ContinuousBatcher:
         )
         chunk_rec = None
         if chunk_idx is None:
-            next_tok, _, self.cache, next_in = self._jit_decode(*args)
-            ev = self._count_program("decode", rows=len(rows_now))
+            if rounds_now:
+                # Same prepared device args as the legacy program
+                # (args[9] is groups — _rounds_sample takes it after
+                # the stop data).
+                next_tok, _, self.cache, next_in, cnt_out, emit_cnt = (
+                    self._jit_rounds(
+                        rounds_now, *args[:9], budgets_dev, screen_dev,
+                        args[9],
+                    )
+                )
+            else:
+                next_tok, _, self.cache, next_in = self._jit_decode(*args)
+            ev = self._count_program(
+                "decode", rows=len(rows_now), rounds=k
+            )
             cost = self._program_cost("decode", rows_now, k)
         else:
             slot = self._slots[chunk_idx]
@@ -2861,17 +3257,29 @@ class ContinuousBatcher:
             ]
             written_end = slot.next_pos + slot.chunk
             chunk_done = written_end >= slot.prompt_len
-            next_tok, _, self.cache, next_in, chunk_logits = self._fused_fn(
-                slot.chunk, slot.s_bucket
-            )(
+            out = self._fused_fn(slot.chunk, slot.s_bucket)(
                 *args,
                 jnp.asarray(chunk_ids[None]),
                 jnp.asarray(slot.table),
                 jnp.int32(slot.next_pos),
                 jnp.int32(slot.prompt_len - 1),
                 chunk_done,
+                *(
+                    (rounds_now, budgets_dev, screen_dev)
+                    if rounds_now
+                    else ()
+                ),
             )
-            ev = self._count_program("fused", rows=len(rows_now) + 1)
+            if rounds_now:
+                (
+                    next_tok, _, self.cache, next_in, chunk_logits,
+                    emit_cnt, cnt_out,
+                ) = out
+            else:
+                next_tok, _, self.cache, next_in, chunk_logits = out
+            ev = self._count_program(
+                "fused", rows=len(rows_now) + 1, rounds=k
+            )
             cost = self._program_cost(
                 "fused", rows_now, k, chunk_ext=(written_end, slot.chunk)
             )
@@ -2915,14 +3323,18 @@ class ContinuousBatcher:
         # dispatch folds the right PRNG indices. With a draft
         # configured, a plain program also widens the row's draft lag
         # (the mirror never saw these tokens — _spec_catch_up replays
-        # them when speculation re-engages).
-        for i, s in rows_now:
-            self._counts[i] += k
-            if self.draft_cache is not None:
-                s.draft_lag += k
+        # them when speculation re-engages). A MULTI-ROUND program's
+        # advance is data-dependent (frozen rows stop folding), so
+        # both mirrors sync at fetch instead — the spec discipline.
+        if not rounds_now:
+            for i, s in rows_now:
+                self._counts[i] += k
+                if self.draft_cache is not None:
+                    s.draft_lag += k
         rec = _Inflight(
             tokens=next_tok, next_input=next_in, t0=t0, k=k,
-            rows=rows_now, chunk=chunk_rec, flight=ev, cost=cost,
+            rows=rows_now, chunk=chunk_rec, rounds=rounds_now,
+            emit_cnt=emit_cnt, counts_out=cnt_out, flight=ev, cost=cost,
         )
         self._dispatch_tail(rec, groups, k)
 
@@ -2962,7 +3374,11 @@ class ContinuousBatcher:
         """
         rec = self._inflight.popleft()
         next_np = np.asarray(rec.tokens)  # [slots, k] — THE host sync
-        cnt_np = np.asarray(rec.emit_cnt) if rec.spec else None
+        cnt_np = (
+            np.asarray(rec.emit_cnt)
+            if (rec.spec or rec.rounds)
+            else None
+        )
         step_end = time.perf_counter()
         # Device-step latency: at depth 1 the program started at its
         # own dispatch; deeper, it started when its predecessor
@@ -3041,11 +3457,24 @@ class ContinuousBatcher:
                     self._spec_acc_sum += frac
                     self._spec_acc_count += 1
                     self._spec_verified_last = emitted
+        if rec.rounds and not rec.spec:
+            # Multi-round program (PR 12): sync the host PRNG-count
+            # mirror by each surviving row's real yield (frozen rounds
+            # folded nothing), and widen the draft lag by the same —
+            # the spec discipline, minus the speculation metrics. Rows
+            # whose slot was retired/reused mid-flight are skipped
+            # exactly like their tokens (a reused slot's activation
+            # reset its count and marked it dirty).
+            for i, s in alive:
+                n = int(cnt_np[i])
+                self._counts[i] += n
+                if self.draft_cache is not None:
+                    s.draft_lag += n
         emitted_total = 0
         tbt_sum, tbt_count = 0.0, 0
         for i, slot in alive:
             done = False
-            n_emit = int(cnt_np[i]) if rec.spec else rec.k
+            n_emit = int(cnt_np[i]) if cnt_np is not None else rec.k
             for j in range(n_emit):
                 tok = int(next_np[i, j])
                 slot.generated.append(tok)
@@ -3137,6 +3566,14 @@ class ContinuousBatcher:
             # the verify program IS the decode dispatch, and a chunk
             # lane on it is future work.
             spec_now = self._spec_ok
+            # Multi-round engage state, read ONCE per iteration next to
+            # spec_now and threaded into _dispatch the same way: the
+            # mode-flush decision and the dispatched program must come
+            # from the same read, or a live decode_rounds flip between
+            # the two would chain a counts-mode mismatch into the
+            # window (a flip is a between-bursts event, but the
+            # scheduler must stay correct if one lands mid-burst).
+            rounds_now = 1 if spec_now else self._rounds
             if self._draft_cfg is not None:
                 # Flight event on TRANSITIONS only (spec_decode is read
                 # per iteration; steady state records nothing).
@@ -3181,19 +3618,40 @@ class ContinuousBatcher:
                 # run. depth 1 reduces to dispatch -> fetch -> bookkeep
                 # (the serialized parity baseline); the while also
                 # drains excess depth after a live depth reduction.
-                if self._inflight and self._inflight[-1].spec != spec_now:
+                if self._inflight:
                     # A plain program feeds the next dispatch from
-                    # host-advanced counts; a spec program from its
-                    # device counts_out. Mixing the two in one window
-                    # would desync the PRNG mirror — drain first (a
-                    # flip is a between-bursts event, never hot-path).
-                    self._flush_pipeline()
+                    # host-advanced counts; spec and multi-round
+                    # programs from their device counts_out. Mixing
+                    # modes in one window would desync the PRNG
+                    # mirror — drain first (a flip is a between-bursts
+                    # event, never hot-path). Multi-round flush
+                    # semantics extend unchanged otherwise: an R-round
+                    # window drains like any other (its programs'
+                    # fetches credit data-dependent yields), so every
+                    # stable-cache operation keeps working under R.
+                    tail = self._inflight[-1]
+                    tail_mode = (
+                        "spec"
+                        if tail.spec
+                        else ("rounds" if tail.rounds else "plain")
+                    )
+                    mode_now = (
+                        "spec"
+                        if spec_now
+                        else ("rounds" if rounds_now > 1 else "plain")
+                    )
+                    if tail_mode != mode_now:
+                        self._flush_pipeline()
                 if spec_now:
                     # Rows that decoded through an off window need
                     # their draft mirror replayed first — no-op in the
                     # steady state (every lag-free iteration).
                     self._spec_catch_up()
-                self._dispatch(chunk_idx if fused else None, spec=spec_now)
+                self._dispatch(
+                    chunk_idx if fused else None,
+                    spec=spec_now,
+                    rounds=rounds_now,
+                )
                 while len(self._inflight) >= self._depth:
                     self._fetch_one()
                 progress = True

@@ -312,7 +312,11 @@ def to_chrome(events: list[FlightEvent]) -> dict:
     (``kind == "program"``) become complete ("X") events on the device
     track — their count equals the ``gateway_device_programs_total``
     delta over the same window (a dispatched-not-yet-fetched program
-    appears with its dispatch stamp and zero duration). Events with a
+    appears with its dispatch stamp and zero duration). That count
+    parity is R-invariant under multi-round decode (PR 12): a program
+    folding R rounds is still ONE slice, carrying ``rounds`` in its
+    args (next to ``rows``/``tokens``) so the timeline shows how much
+    decoding each dispatch held. Events with a
     duration become "X" slices, instantaneous ones "i" instants.
     Request-span events (``kind == "request"``, recorded at
     retirement) each get their own thread row named by request id.

@@ -11,6 +11,17 @@ continuous`` asserts it across the Backend seam) lives HERE once:
   check must decode to be able to see a stop that ends at the newest
   token (longest stop's token length plus slack for a stop/multibyte
   sequence straddling the window head).
+- :func:`single_token_stop_ids` — the ids a DEVICE loop may terminate
+  on exactly (stops that encode to one id), shared by the engine's
+  single-round batch path and anything else that device-stops.
+- :func:`derived_stop_screen` — the CONSERVATIVE device-side token
+  screen multi-round decode (PR 12) freezes on: every id whose decoded
+  bytes could complete some stop. A screen hit is a *candidate*, not a
+  verdict — the host's byte-level :meth:`VisibleIdFilter.
+  confirmed_stop_hit` stays authoritative at fetch, so text is
+  byte-identical whether the screen over- or under-fires; what the
+  screen buys is that a row freezes (no further K/V writes, no further
+  PRNG folds) at the first token that could possibly end it.
 
 A precedence or slack change edited here propagates to every surface;
 duplicated inline copies would silently disagree.
@@ -112,6 +123,68 @@ class VisibleIdFilter:
             return False
         full = full_text()
         return any(s in full for s in stops)
+
+
+def single_token_stop_ids(tokenizer, stops: Iterable[str]) -> tuple[int, ...]:
+    """Stops that tokenize to exactly one id — the EXACT device-side
+    terminators (a row sampling one of them finishes as if it sampled
+    EOS). The engine's batch decode loop has always device-stopped
+    these; the derivation lives here so the multi-round batcher and the
+    engine read the same rule. Order-preserving, deduplicated."""
+    ids = []
+    for s in stops:
+        enc = tokenizer.encode(s, add_bos=False)
+        if len(enc) == 1:
+            ids.append(int(enc[0]))
+    return tuple(dict.fromkeys(ids))
+
+
+def derived_stop_screen(
+    tokenizer,
+    stops: Iterable[str],
+    *,
+    max_ids: int = 8,
+    max_vocab_scan: int = 4096,
+) -> tuple[int, ...] | None:
+    """Conservative single-token screen for device-side stop freezing.
+
+    A stop sequence can only COMPLETE at a token whose contributed
+    bytes contain the stop's final byte — so the set of ids whose
+    decoded bytes contain any stop's last byte (plus ids that decode to
+    nothing alone: byte-fallback fragments contribute bytes only in
+    context, so they might hide the completing byte) is a sound screen
+    for per-id-additive tokenizers: freeze the row at the first
+    screened token, let the host's byte-level check confirm or resume.
+    A false positive costs rounds, never correctness (the host trim at
+    fetch is authoritative either way — see the module docstring).
+
+    Returns ``()`` for no stops, a tuple of <= ``max_ids`` candidate
+    ids when a usable screen exists, or ``None`` when no bounded screen
+    is derivable — more than ``max_ids`` candidates (membership rides
+    the decode program as a fixed-width row of data, so a fat screen
+    would freeze constantly and bloat the program), or a vocabulary
+    too large to scan (``max_vocab_scan``; the one-time scan decodes
+    every id). ``None`` tells the caller to bound the multi-round
+    window to 1 so the host sees every token — the pre-PR-12 cadence,
+    exact for any tokenizer. Callers should memoize per stop tuple:
+    the scan is O(vocab) and submit paths pace device steps.
+    """
+    stops = [s for s in stops if s]
+    if not stops:
+        return ()
+    if getattr(tokenizer, "vocab_size", max_vocab_scan + 1) > max_vocab_scan:
+        return None
+    last_bytes = {
+        s.encode("utf-8", errors="surrogateescape")[-1:] for s in stops
+    }
+    ids: list[int] = []
+    for t in range(tokenizer.vocab_size):
+        bs = tokenizer.decode([t]).encode("utf-8", errors="surrogateescape")
+        if not bs or any(b in bs for b in last_bytes):
+            ids.append(t)
+            if len(ids) > max_ids:
+                return None
+    return tuple(ids)
 
 
 def stop_tail_window(tokenizer, stops: Iterable[str], slack: int = 8) -> int:

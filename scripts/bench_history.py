@@ -27,6 +27,10 @@ Verdict semantics (``--check`` exits 1 only on REGRESSION):
   see the r3/r4 llama-1b medians in README) -> ``regression``
 - measured rounds exist but the LATEST round is no-data -> ``stale``
   (exit 0: an outage must not block CI, the trajectory just flags it)
+- the ratio compares SAME-UNIT rounds only: a round whose artifact is
+  one of the serving A/B legs' payloads (``tokens/sec`` — e.g. PR 12's
+  ``--serve-decode-rounds``) is never ratioed against the headline
+  ``tokens/sec/chip`` rows; a unit change starts a fresh trajectory.
 
 Stdlib-only, < 1 s, runs anywhere (no jax import).
 """
@@ -142,7 +146,17 @@ def verdict(bench: list[dict], threshold: float) -> dict:
             "no-data, never 0-tok/s measurements)",
         }
     latest = measured[-1]
-    earlier = measured[:-1]
+    # Same-unit comparison only: the serving A/B legs (PR 12's
+    # --serve-decode-rounds and friends) emit "tokens/sec" payloads a
+    # driver may commit as a round artifact next to the headline
+    # "tokens/sec/chip" rows — ratioing across units would fire (or
+    # mask) regressions that never happened. A unit CHANGE therefore
+    # starts a fresh trajectory, like the r4 re-baseline did.
+    earlier = [
+        r
+        for r in measured[:-1]
+        if r.get("unit", "") == latest.get("unit", "")
+    ]
     doc = {
         "latest_measured_round": latest["round"],
         "latest_value": latest["value"],

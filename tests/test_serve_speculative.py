@@ -514,7 +514,7 @@ def test_spec_flip_mid_decode_replays_draft_mirror(params):
     state = {"plain": 0, "draft_at_flip": None}
     real_dispatch = b._dispatch
 
-    def flipping_dispatch(chunk_idx=None, spec=False):
+    def flipping_dispatch(chunk_idx=None, spec=False, **kw):
         if not spec:
             state["plain"] += 1
             # Past one full chunk width of lag (20 > prefill_chunk 16):
@@ -523,7 +523,7 @@ def test_spec_flip_mid_decode_replays_draft_mirror(params):
             if state["plain"] == 20:
                 state["draft_at_flip"] = b.stats()["device_programs_draft"]
                 b.config.spec_decode = True
-        real_dispatch(chunk_idx, spec=spec)
+        real_dispatch(chunk_idx, spec=spec, **kw)
 
     b._dispatch = flipping_dispatch
     try:
