@@ -421,6 +421,29 @@ def main() -> int:
         "--serve-ragged-attention",
     )
     p.add_argument(
+        "--serve-mesh",
+        action="store_true",
+        help="mesh-native serving A/B leg (PR 13): the PR-8 mixed "
+        "panel burst (shared headers + unique prefixes) served by a "
+        "dp2×mp2 MESH batcher vs a single-device batcher — "
+        "byte-identical text REQUIRED per pair (every serving "
+        "feature now engages on the mesh), gates the mesh leg's "
+        "device programs per scheduler iteration == 1.0 (fused "
+        "ragged dispatch really engaged), and reports per-leg tok/s "
+        "through the PR-5 dual gate at a generous band (a "
+        "CPU-simulated mesh pays collective emulation on shared "
+        "cores; the gate catches pathological collapse, the chip "
+        "rows land with the next bench round). Needs >= 4 devices "
+        "(the leg forces xla_force_host_platform_device_count=8 on "
+        "CPU)",
+    )
+    p.add_argument(
+        "--mesh-ab-rounds",
+        type=int,
+        default=2,
+        help="alternating single/mesh paired rounds for --serve-mesh",
+    )
+    p.add_argument(
         "--serve-speculative",
         action="store_true",
         help="speculative-decoding A/B leg (PR 9): the same greedy "
@@ -546,6 +569,23 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
     if args.tiny:
         args.model = "test-tiny"
+    if args.serve_mesh and (
+        args.cpu
+        or os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    ):
+        # The mesh leg needs >= 4 devices; on CPU that means simulated
+        # host devices, whose count is an XLA backend-init flag. jax is
+        # imported but the CPU backend initializes lazily at the first
+        # device query, so setting the flag here (before any
+        # jax.devices() below) is early enough — unless something
+        # already initialized it, which the leg detects and reports.
+        # Keyed on the resolved platform (--cpu OR the JAX_PLATFORMS
+        # env convention), not the flag alone.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     from llm_consensus_tpu.engine.generate import generate
     from llm_consensus_tpu.models.configs import get_config
@@ -623,6 +663,14 @@ def main() -> int:
         f"pallas={use_pallas}",
         file=sys.stderr,
     )
+
+    if args.serve_mesh:
+        # Dispatch BEFORE the main param build: the mesh leg re-inits
+        # fp32 params itself (cross-topology byte parity needs
+        # order-stable numerics), so building the bf16/quantized tree
+        # here would be pure wasted startup time and transient double
+        # param memory.
+        return _bench_serving_mesh_ab(args, cfg, None)
 
     # Flagship-scale guard: init+quantize on-device holds bf16 AND the
     # quantized copy at once (~24 GB for 8B int8) — OOM on a 16 GB v5e.
@@ -782,6 +830,39 @@ def main() -> int:
         args.out,
     )
     return 0
+
+
+def _burst_leg(batcher, prompts, new_tokens):
+    """One quiesced burst through a batcher; returns (texts, tok/s,
+    device programs per scheduler work iteration). ONE copy of the
+    programs/iteration accounting for every leg that gates on it (the
+    ragged and mesh A/B legs) — two copies of the stats-key sum is how
+    the PR-9 dispatch-tail drift happened."""
+    _quiesce_batcher(batcher)
+    s0 = batcher.stats()
+    t0 = time.perf_counter()
+    futs = [
+        batcher.submit(p, max_new_tokens=new_tokens) for p in prompts
+    ]
+    results = [f.result(timeout=600) for f in futs]
+    wall = time.perf_counter() - t0
+    _quiesce_batcher(batcher)
+    s1 = batcher.stats()
+    programs = sum(
+        s1[k] - s0[k]
+        for k in (
+            "device_programs_fused",
+            "device_programs_decode",
+            "device_programs_prefill",
+        )
+    )
+    iters = s1["work_iterations"] - s0["work_iterations"]
+    toks = sum(r.num_tokens for r in results)
+    return (
+        [r.text for r in results],
+        toks / wall,
+        programs / max(1, iters),
+    )
 
 
 def _quiesce_batcher(batcher, timeout: float = 10.0) -> None:
@@ -1450,32 +1531,7 @@ def _bench_serving_ragged_ab(args, cfg, params) -> int:
     def leg(batcher, ragged, prompts):
         """One burst; returns (texts, tok/s, programs-per-iteration)."""
         batcher.config.ragged_attention = ragged
-        _quiesce_batcher(batcher)
-        s0 = batcher.stats()
-        t0 = time.perf_counter()
-        futs = [
-            batcher.submit(p, max_new_tokens=args.new_tokens)
-            for p in prompts
-        ]
-        results = [f.result(timeout=600) for f in futs]
-        wall = time.perf_counter() - t0
-        _quiesce_batcher(batcher)
-        s1 = batcher.stats()
-        programs = sum(
-            s1[k] - s0[k]
-            for k in (
-                "device_programs_fused",
-                "device_programs_decode",
-                "device_programs_prefill",
-            )
-        )
-        iters = s1["work_iterations"] - s0["work_iterations"]
-        toks = sum(r.num_tokens for r in results)
-        return (
-            [r.text for r in results],
-            toks / wall,
-            programs / max(1, iters),
-        )
+        return _burst_leg(batcher, prompts, args.new_tokens)
 
     runs = {False: [], True: []}  # ragged -> [(tok/s, ratio)]
     diverged = False
@@ -1601,6 +1657,204 @@ def _bench_serving_ragged_ab(args, cfg, params) -> int:
             "exercise the fusion; resize the leg",
             file=sys.stderr,
         )
+        return 1
+    return 0
+
+
+def _bench_serving_mesh_ab(args, cfg, params) -> int:
+    """Mesh-native serving hot path A/B (PR 13).
+
+    The PR-8 mixed panel burst (shared headers + unique prefixes)
+    served by a dp2×mp2 MESH batcher vs a single-device batcher —
+    every serving feature now engages on the mesh, so the contract is
+    the strong one: byte-identical text per pair, and the mesh leg
+    runs EXACTLY one device program per scheduler work iteration
+    (fused ragged dispatch engaged — the number that used to be
+    unreachable because fusion fell back off-mesh). Two batchers, one
+    per topology (a mesh is constructor state, not a live lever); the
+    prompts of each round are shared verbatim so the text gate is a
+    strict pair-wise equality.
+
+    tok/s is reported per leg through the PR-5 dual gate at a
+    GENEROUS band: on this CPU box the mesh is 8 simulated host
+    devices time-slicing the same cores, so the leg can only gate
+    against pathological collapse (per-step recompiles, a broken
+    collective), not parity — the chip rows land with the next bench
+    round.
+    """
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+
+    # Byte parity across TOPOLOGIES (unlike the single-batcher A/B
+    # legs, whose two bursts share one reduction order) needs
+    # order-stable numerics: bf16-input matmuls at the fast default
+    # precision leave logit near-ties that the mesh's psum reordering
+    # flips. fp32 params + full-precision accumulation keep the
+    # greedy argmax stable — the same regime the tier-1 parity grid
+    # pins (tests/test_mesh_serving.py). Both legs share the regime,
+    # so the tok/s comparison stays fair. main() dispatches this leg
+    # BEFORE its param build (``params`` arrives None) — this is the
+    # one place the leg's tree is created.
+    del params
+    jax.config.update("jax_default_matmul_precision", "highest")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    if len(jax.devices()) < 4:
+        _emit(
+            {
+                "metric": "serving tok/s, mesh-native hot path "
+                f"({cfg.name}): SKIPPED — needs >= 4 devices, have "
+                f"{len(jax.devices())} (backend initialized before "
+                "the device-count flag could apply)",
+                "value": 0.0,
+                "unit": "tokens/sec",
+                "vs_baseline": 0.0,
+                "status": "mesh-unavailable",
+            },
+            args.out,
+        )
+        return 1
+    mesh = make_mesh(
+        MeshConfig(data=2, model=2), devices=jax.devices()[:4]
+    )
+
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    n = args.serve_requests
+    longest = header_target + 64
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    chunk = args.serve_prefill_chunk or 64
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], args.new_tokens, args.serve_chunk, pg
+    )
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2
+    # n_pages and max_slots must divide the data axis (2).
+    n_pages += n_pages % 2
+    slots = args.serve_slots + args.serve_slots % 2
+    header = f"Mesh panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+
+    def make_batcher(topo_mesh):
+        return ContinuousBatcher(
+            cfg,
+            params,
+            config=ContinuousConfig(
+                max_slots=slots,
+                page_size=pg,
+                n_pages=n_pages,
+                pages_per_seq=pages_per_seq,
+                max_new_tokens=args.new_tokens,
+                seq_buckets=tuple(buckets),
+                steps_per_sync=args.serve_chunk,
+                prefill_chunk=chunk,
+                share_prefix=True,
+            ),
+            mesh=topo_mesh,
+        )
+
+    def mixed_prompts(tag):
+        out = []
+        for i in range(n):
+            if i % 2 == 0:
+                out.append(header + f"Q{tag}-{i}: item {i * 37 % 101}?")
+            else:
+                out.append(
+                    f"Unique header {salt}-{tag}-{i}: "
+                    + f"context {i} " * (-(-header_target // 11))
+                    + "tail?"
+                )
+        return out
+
+    def leg(batcher, prompts):
+        """One burst; returns (texts, tok/s, programs/iteration)."""
+        return _burst_leg(batcher, prompts, args.new_tokens)
+
+    batchers = {False: make_batcher(None), True: make_batcher(mesh)}
+    runs = {False: [], True: []}  # on_mesh -> [(tok/s, ratio)]
+    diverged = False
+    try:
+        # Concurrent warmup on each topology: compiles the fused
+        # program family (a chunk only rides a dispatch when rows are
+        # decoding) so the first timed round isn't XLA compilation.
+        for on_mesh, b in batchers.items():
+            futs = [
+                b.submit(
+                    header + f"warm {on_mesh} {i}",
+                    max_new_tokens=args.new_tokens,
+                )
+                for i in range(min(4, n))
+            ]
+            for f in futs:
+                f.result(timeout=600)
+        for r in range(max(1, args.mesh_ab_rounds)):
+            prompts = mixed_prompts(f"r{r}")
+            order = (False, True) if r % 2 == 0 else (True, False)
+            got = {}
+            for on_mesh in order:
+                texts, tps, ratio = leg(batchers[on_mesh], prompts)
+                got[on_mesh] = texts
+                runs[on_mesh].append((tps, ratio))
+            if got[False] != got[True]:
+                diverged = True
+    finally:
+        for b in batchers.values():
+            b.close()
+
+    best_single = max(t for t, _ in runs[False])
+    best_mesh = max(t for t, _ in runs[True])
+    ratio_mesh = max(r for _, r in runs[True])  # worst round gates
+    stats_mesh = {
+        "data": int(mesh.shape.get("data", 1)),
+        "model": int(mesh.shape.get("model", 1)),
+    }
+    # Dual gate at a generous band: 75% collapse allowance on the
+    # CPU-simulated mesh (collective emulation shares the cores); a
+    # broken mesh path (per-step recompiles) blows through it.
+    tput_ok = _dual_gate_ok(
+        [t for t, _ in runs[False]], [t for t, _ in runs[True]], pct=75.0
+    )
+    # Gates decide status BEFORE the emit (the rounds-leg convention):
+    # a regressed run must never land in the bench history as "ok".
+    status = "ok"
+    if diverged:
+        status = "failed: text diverged between mesh and single device"
+    elif ratio_mesh > 1.0 + 1e-9:
+        status = (
+            f"failed: mesh programs/iteration {ratio_mesh:.3f} "
+            "(target 1.0) — fused dispatch not engaging"
+        )
+    elif not tput_ok:
+        status = (
+            f"failed: mesh tok/s collapsed past the generous band "
+            f"(best {best_mesh:.0f} vs single {best_single:.0f})"
+        )
+    _emit(
+        {
+            "metric": f"serving tok/s, mesh-native hot path "
+            f"({cfg.name}, dp{stats_mesh['data']}×mp"
+            f"{stats_mesh['model']} vs single device, "
+            f"{len(runs[True])}x{n} mixed reqs, slots={slots}, "
+            f"decode {args.new_tokens} @ ~{header_target} prompts, "
+            f"chunk={chunk}, mesh programs/iteration "
+            f"{ratio_mesh:.2f}, single best {best_single:.0f} tok/s, "
+            f"text equal={not diverged})",
+            "value": round(best_mesh, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(best_mesh / max(best_single, 1e-9), 4),
+            "status": status,
+        },
+        args.out,
+    )
+    if status != "ok":
+        print(f"[bench] serve-mesh leg: {status}", file=sys.stderr)
         return 1
     return 0
 

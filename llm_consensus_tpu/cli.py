@@ -265,7 +265,8 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "or its token budget mid-window freezes (no further KV "
         "writes or PRNG folds) while neighbors keep decoding; the "
         "host fetches once per R rounds. Text is byte-identical to "
-        "1 (the default); engages off-mesh with steps-per-sync 1, "
+        "1 (the default); engages with steps-per-sync 1 on every "
+        "topology (meshes included since PR 13), "
         "and requests whose stop sequences have no bounded device "
         "screen collapse the window to 1 while they decode",
     )

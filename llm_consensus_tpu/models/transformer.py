@@ -1081,6 +1081,57 @@ def prefill(
     return logits, cache.with_length(lengths)
 
 
+def _replicated(mesh, x, head_axis=None):
+    """Constrain ``x`` so its leading (batch/concat) axis is
+    UNSHARDED on ``mesh`` (no-op off-mesh). The fused step
+    concatenates per-row arrays along the batch axis, and XLA's SPMD
+    partitioner MISCOMPILES a concatenation along a sharded dimension
+    on this jax (observed on 0.4.37 CPU: every element comes out
+    doubled — each shard's halo contribution is summed twice).
+    De-sharding the concat axis on both operands AND the result
+    sidesteps the broken lowering (propagation from downstream
+    consumers can re-shard a pinned-input concat, so the result is
+    pinned too). ``head_axis``: keep THAT axis sharded over ``model``
+    when it divides — the attention outputs feed the row-sharded
+    ``wo`` GEMM, and fully replicating them would forfeit the TP
+    sharding of the attention→wo contraction on a real chip mesh; the
+    position/token vectors pass no head_axis (a handful of scalars
+    per row — full replication is noise next to the GEMMs)."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    parts = [None] * x.ndim
+    if head_axis is not None:
+        mp = int(mesh.shape.get("model", 1))
+        if mp > 1 and x.shape[head_axis] % mp == 0:
+            parts[head_axis] = "model"
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*parts))
+    )
+
+
+def ragged_mesh_shardable(cfg: ModelConfig, mesh, max_slots: int,
+                          n_pages: int) -> bool:
+    """Whether the Pallas ragged kernel can run under ``shard_map`` on
+    this mesh (PR 13): kv heads must split over ``model`` and the
+    decode rows / page pool over ``data``. When any axis fails to
+    divide, the serving stack still engages every feature on the mesh
+    — attention just takes the XLA reference (sharded by GSPMD) instead
+    of the manually-partitioned kernel. This predicate is the ONE
+    remaining kernel fallback condition on a mesh; callers surface it
+    as the residual construction warning."""
+    if mesh is None:
+        return False
+    dp = int(mesh.shape.get("data", 1))
+    mp = int(mesh.shape.get("model", 1))
+    return (
+        cfg.n_kv_heads % mp == 0
+        and max_slots % dp == 0
+        and n_pages % dp == 0
+    )
+
+
 def _attn_paged(
     cfg: ModelConfig,
     q_dec,
@@ -1092,24 +1143,29 @@ def _attn_paged(
     chunk_table=None,
     chunk_start=None,
     groups=None,
+    mesh=None,
 ):
     """Paged attention for one layer's decode rows (+ optional prefill
     chunk row) — THE kernel-selection seam of the serving stack, and
     deliberately a short one: ``cfg.use_pallas`` picks the ragged
     kernel, anything else the XLA gather reference with identical
     ragged semantics. Window, groups, and mixed rows are all cases of
-    the one kernel — the old per-feature fallback matrix is gone (mesh
-    stays a caller-level fallback: pallas_call is opaque to GSPMD).
+    the one kernel — the old per-feature fallback matrix is gone.
+
+    ``mesh`` (trace-time constant, PR 13): on a dp×mp mesh the Pallas
+    kernel runs under ``shard_map`` — kv heads partitioned over
+    ``model``, decode rows and the page pool over ``data`` (the page
+    allocator's slot→shard affinity keeps every row's table
+    shard-local), group programs riding with their members' shard and
+    the chunk lane resolved on its owner shard. When the mesh shapes
+    don't divide (``ragged_mesh_shardable``) the XLA reference runs
+    instead — GSPMD shards it — so every serving feature still engages.
 
     q_dec: [B, H, D]; q_chunk: [C, H, D] or None; returns out_dec
     [B, H, D] (and out_chunk [C, H, D] when q_chunk is given).
     """
     window = cfg.sliding_window
     if cfg.use_pallas:
-        from llm_consensus_tpu.ops.pallas.attention import (
-            ragged_paged_attention,
-        )
-
         gtuple = None
         if groups is not None:
             gtuple = (
@@ -1118,11 +1174,29 @@ def _attn_paged(
                 groups.group_pages.astype(jnp.int32) * k_pool.shape[1],
                 groups.shared_start,
             )
-        return ragged_paged_attention(
-            q_dec, k_pool, v_pool, tables, valid,
-            q_chunk=q_chunk, chunk_table=chunk_table,
-            chunk_start=chunk_start, groups=gtuple, window=window,
-        )
+        if mesh is not None:
+            if ragged_mesh_shardable(
+                cfg, mesh, q_dec.shape[0], k_pool.shape[0]
+            ):
+                from llm_consensus_tpu.ops.pallas.attention import (
+                    ragged_paged_attention_sharded,
+                )
+
+                return ragged_paged_attention_sharded(
+                    mesh, q_dec, k_pool, v_pool, tables, valid,
+                    q_chunk=q_chunk, chunk_table=chunk_table,
+                    chunk_start=chunk_start, groups=gtuple, window=window,
+                )
+        else:
+            from llm_consensus_tpu.ops.pallas.attention import (
+                ragged_paged_attention,
+            )
+
+            return ragged_paged_attention(
+                q_dec, k_pool, v_pool, tables, valid,
+                q_chunk=q_chunk, chunk_table=chunk_table,
+                chunk_start=chunk_start, groups=gtuple, window=window,
+            )
     from llm_consensus_tpu.ops.attention import (
         ragged_paged_attention_reference,
     )
@@ -1141,6 +1215,7 @@ def decode_step_paged(
     cache,
     groups=None,
     write_mask=None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, object]:
     """One decode step for every cache sequence, paged layout.
 
@@ -1172,6 +1247,12 @@ def decode_step_paged(
     decoding. Frozen rows still flow through the matmuls (SIMD rows
     are not skippable); their logits are garbage the caller discards.
     None (default) = every row live, exactly the pre-PR-12 step.
+
+    ``mesh`` (trace-time constant, PR 13): run the attention read
+    through the mesh-partitioned kernel seam (see :func:`_attn_paged`).
+    Everything else in the step — the QKV/WO/MLP GEMMs, the K/V pool
+    scatter — is plain jnp that GSPMD shards from the operands'
+    NamedShardings; only the pallas_call needs the explicit seam.
     """
     from llm_consensus_tpu.models.paged_cache import NULL_PAGE, PagedKVCache
 
@@ -1201,7 +1282,7 @@ def decode_step_paged(
         v_pool = v_pool.at[pages_now, offset].set(v[:, 0].astype(v_pool.dtype))
         attn = _attn_paged(
             cfg, q[:, 0], None, k_pool, v_pool, tables, pos + adv,
-            groups=groups,
+            groups=groups, mesh=mesh,
         )[:, None]  # [B, H, D] -> [B, 1, H, D] (seq axis restored)
         y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
@@ -1224,6 +1305,7 @@ def verify_step_paged(
     tokens: jnp.ndarray,
     cache,
     groups=None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, object]:
     """Speculative VERIFY step: NQ tokens per cache sequence, one
     program (PR 9 — :func:`decode_step_paged` widened to k+1-token
@@ -1277,7 +1359,8 @@ def verify_step_paged(
         k_pool = k_pool.at[pages, offs].set(k.astype(k_pool.dtype))
         v_pool = v_pool.at[pages, offs].set(v.astype(v_pool.dtype))
         attn = _attn_paged(
-            cfg, q, None, k_pool, v_pool, tables, pos0 + nq, groups=groups
+            cfg, q, None, k_pool, v_pool, tables, pos0 + nq, groups=groups,
+            mesh=mesh,
         )  # [B, NQ, H, D]
         y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
@@ -1301,6 +1384,7 @@ def prefill_chunk_paged(
     table: jnp.ndarray,
     start: jnp.ndarray,
     cache,
+    mesh=None,
 ) -> tuple[jnp.ndarray, object]:
     """One prompt chunk for ONE sequence, scattered into paged K/V.
 
@@ -1339,6 +1423,7 @@ def prefill_chunk_paged(
     pg = cache.page_size
     pages = table[pos // pg]  # [C] destination page per chunk token
     offs = pos % pg
+    nb = 1 if mesh is None else int(mesh.shape.get("data", 1))
 
     def body(carry, layer_in):
         p, k_pool, v_pool = layer_in  # pools [n_pages, page, Hkv, Dh]
@@ -1349,22 +1434,28 @@ def prefill_chunk_paged(
         k_pool = k_pool.at[pages, offs].set(k[0].astype(k_pool.dtype))
         v_pool = v_pool.at[pages, offs].set(v[0].astype(v_pool.dtype))
         # Chunk-only ragged call through the SAME kernel seam as the
-        # fused step (one dead decode row: NULL table, valid 0) — a
+        # fused step (dead decode rows: NULL table, valid 0) — a
         # standalone chunk and a fused chunk must write bit-identical
         # cache bytes, which means one attention arithmetic for both
         # (on use_pallas configs the kernel and the XLA reference only
         # agree to tolerance, so mixing them would break the
         # ragged_attention on/off byte-parity contract mid-prefill).
+        # On a mesh the dummy decode batch is sized to the data axis:
+        # a 1-row batch cannot shard over dp > 1, which would silently
+        # route the STANDALONE chunk to the reference while fused
+        # chunks run the sharded kernel — the same mixed-arithmetic
+        # hazard, reintroduced by topology instead of by feature flag.
         attn = _attn_paged(
             cfg,
-            jnp.zeros((1, cfg.n_heads, cfg.head_dim), q.dtype),
+            jnp.zeros((nb, cfg.n_heads, cfg.head_dim), q.dtype),
             q[0],
             k_pool,
             v_pool,
-            jnp.zeros((1, table.shape[0]), jnp.int32),
-            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((nb, table.shape[0]), jnp.int32),
+            jnp.zeros((nb,), jnp.int32),
             chunk_table=table,
             chunk_start=start,
+            mesh=mesh,
         )[1][None]  # out_chunk [C, H, D] -> [1, C, H, D]
         y = carry + _qmm(attn.reshape(*carry.shape[:-1], -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
@@ -1390,6 +1481,7 @@ def fused_step_paged(
     chunk_start: jnp.ndarray,
     groups=None,
     cfg_chunk: ModelConfig | None = None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, object]:
     """One decode step for every cache sequence PLUS one prefill chunk
     — a single device program (the fused scheduler step).
@@ -1426,17 +1518,32 @@ def fused_step_paged(
     c = chunk_tokens.shape[1]
     pos = cache.length  # [B] decode write positions
     chunk_pos = chunk_start + jnp.arange(c)  # [C] absolute positions
-    all_pos = jnp.concatenate([pos, chunk_pos])
+    # Concats along the [B + C] axis go through _replicated on BOTH
+    # the operands and the result: the decode-side operands arrive
+    # data-sharded and XLA's partitioner miscompiles a concatenation
+    # along a sharded dim (see _replicated) — and sharding propagation
+    # from downstream consumers can re-shard the concat node even when
+    # its inputs are pinned, so the output is pinned too.
+    # Scatter/gather indices keep their native sharding.
+    all_pos = _replicated(
+        mesh, jnp.concatenate([_replicated(mesh, pos), chunk_pos])
+    )
     x = params["embed"][
-        jnp.concatenate([tokens[:, 0], chunk_tokens[0]])
+        _replicated(
+            mesh,
+            jnp.concatenate(
+                [_replicated(mesh, tokens[:, 0]), chunk_tokens[0]]
+            ),
+        )
     ][None]  # [1, B+C, D]
     cos, sin = rope_cos_sin(
         all_pos[None], cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
     pg = cache.page_size
     pages_dec = cache.page_table[jnp.arange(b), pos // pg]  # [B]
-    all_pages = jnp.concatenate([pages_dec, chunk_table[chunk_pos // pg]])
-    all_offs = all_pos % pg
+    offs_dec = pos % pg
+    pages_ch = chunk_table[chunk_pos // pg]
+    offs_ch = chunk_pos % pg
     tables = cache.page_table
     mlp_split = cfg.is_moe and cfg_chunk is not cfg
 
@@ -1446,13 +1553,37 @@ def fused_step_paged(
         q, k, v = _project_qkv(cfg, p, h)  # [1, B+C, H, Dh]
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_pool = k_pool.at[all_pages, all_offs].set(k[0].astype(k_pool.dtype))
-        v_pool = v_pool.at[all_pages, all_offs].set(v[0].astype(v_pool.dtype))
+        # TWO scatters, decode rows then the chunk lane, over DISJOINT
+        # real pages (decode writes private pages, the chunk writes
+        # positions >= chunk_start of its own table). One concatenated
+        # scatter would mix a data-sharded index vector with the
+        # chunk's replicated one, which XLA's SPMD partitioner
+        # miscompiles on a mesh (observed on jax 0.4.37 CPU: spurious
+        # writes to a page nobody indexed); split, each scatter's
+        # indices carry ONE consistent sharding and both partition
+        # correctly. Single-device bytes are unchanged (disjoint
+        # targets; only the NULL page's garbage ordering can differ).
+        k0 = k[0].astype(k_pool.dtype)
+        v0 = v[0].astype(v_pool.dtype)
+        k_pool = k_pool.at[pages_dec, offs_dec].set(k0[:b])
+        v_pool = v_pool.at[pages_dec, offs_dec].set(v0[:b])
+        k_pool = k_pool.at[pages_ch, offs_ch].set(k0[b:])
+        v_pool = v_pool.at[pages_ch, offs_ch].set(v0[b:])
         attn_dec, attn_ch = _attn_paged(
             cfg, q[0, :b], q[0, b:], k_pool, v_pool, tables, pos + 1,
             chunk_table=chunk_table, chunk_start=chunk_start, groups=groups,
+            mesh=mesh,
         )
-        attn = jnp.concatenate([attn_dec, attn_ch])[None]  # [1, B+C, H, Dh]
+        attn = _replicated(
+            mesh,
+            jnp.concatenate(
+                [
+                    _replicated(mesh, attn_dec, head_axis=1),
+                    _replicated(mesh, attn_ch, head_axis=1),
+                ]
+            ),
+            head_axis=1,
+        )[None]  # [1, B+C, H, Dh]
         y = carry + _qmm(attn.reshape(1, b + c, -1), p["wo"])
         h2 = _rms(cfg, y, p["mlp_norm"])
         if mlp_split:

@@ -278,7 +278,12 @@ def ragged_paged_attention_reference(
     window: int = 0,
 ):
     """XLA reference for the ragged paged attention kernel — the parity
-    oracle and the non-Pallas serving path.
+    oracle, the non-Pallas serving path, AND the mesh fallback: when
+    the Pallas kernel can't shard over a mesh (``transformer.
+    ragged_mesh_shardable`` — e.g. kv heads indivisible by the model
+    axis), the serving stack runs THIS function under GSPMD, which
+    partitions the gathers/softmax automatically, so every feature
+    still engages (PR 13).
 
     Same ragged semantics as
     :func:`llm_consensus_tpu.ops.pallas.ragged_paged_attention`,

@@ -1283,6 +1283,143 @@ def ragged_paged_attention(
     )
 
 
+def ragged_paged_attention_sharded(
+    mesh,
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    *,
+    q_chunk: jnp.ndarray | None = None,
+    chunk_table: jnp.ndarray | None = None,
+    chunk_start=None,
+    groups: tuple | None = None,
+    window: int = 0,
+    interpret: bool | None = None,
+):
+    """:func:`ragged_paged_attention` under ``shard_map`` on a dp×mp
+    mesh (PR 13) — the serving kernel's mesh-native lowering.
+
+    Partitioning: kv heads over ``model`` (each shard's kernel runs the
+    same body over Hkv/mp heads — GQA keeps K/V read once per local
+    head program); decode rows, their page tables, and the page pool
+    over ``data``. The batcher's slot→shard page affinity is the
+    correctness invariant: every row's table references only pages of
+    its own data shard, so per-shard the GLOBAL page ids rebase to
+    local pool indices (``id - shard * local_pages``, clamped — NULL
+    and foreign ids appear only in dead/masked steps, where the clamp
+    lands on a harmless masked read, exactly like the kernel's own
+    page-0 sentinel remap). Shared-prefix groups live entirely on one
+    shard for the same reason (one prefix registry per shard), so the
+    group phase rides along by rebasing ``group_rep``: a shard that
+    holds no members of group g folds an all-masked read (l = 0) that
+    the LSE merge ignores. The prefill-chunk lane's pages live on its
+    admitting slot's shard; every shard folds the lane against its
+    local pool and the owner's result is selected with one psum over
+    ``data`` (non-owners contribute exact zeros).
+
+    Semantics are identical to the single-device kernel — this wrapper
+    only decides which shard reads which bytes.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from llm_consensus_tpu.parallel.compat import shard_map
+
+    has_chunk = q_chunk is not None
+    has_groups = groups is not None
+    q_spec = (
+        P("data", None, "model", None)
+        if q.ndim == 4
+        else P("data", "model", None)
+    )
+    pool_spec = P("data", None, "model", None)
+    in_specs = [q_spec, pool_spec, pool_spec, P("data", None), P("data")]
+    args = [
+        q,
+        k_pool,
+        v_pool,
+        page_table.astype(jnp.int32),
+        valid_len.astype(jnp.int32),
+    ]
+    if has_chunk:
+        args += [
+            q_chunk,
+            chunk_table.astype(jnp.int32),
+            jnp.asarray(chunk_start, jnp.int32),
+        ]
+        in_specs += [P(None, "model", None), P(None), P()]
+    if has_groups:
+        gid, rep, gend, sstart = groups
+        args += [
+            gid.astype(jnp.int32),
+            rep.astype(jnp.int32),
+            gend.astype(jnp.int32),
+            sstart.astype(jnp.int32),
+        ]
+        in_specs += [P("data"), P(None), P(None), P("data")]
+    out_specs = (q_spec, P(None, "model", None)) if has_chunk else q_spec
+
+    def fn(*a):
+        q_l, kp_l, vp_l, tbl_l, val_l = a[:5]
+        i = 5
+        local_pages = kp_l.shape[0]
+        bl = q_l.shape[0]
+        didx = jax.lax.axis_index("data")
+        poff = didx * local_pages
+        tbl = jnp.clip(tbl_l - poff, 0, local_pages - 1)
+        qc = ct = cs = None
+        if has_chunk:
+            qc, ct, cs = a[i : i + 3]
+            i += 3
+        g_l = None
+        if has_groups:
+            gid_l, rep_g, gend_g, sst_l = a[i : i + 4]
+            g_l = (
+                gid_l,
+                jnp.clip(rep_g - didx * bl, 0, bl - 1),
+                gend_g,
+                sst_l,
+            )
+        if has_chunk:
+            out_dec, out_chunk = ragged_paged_attention(
+                q_l,
+                kp_l,
+                vp_l,
+                tbl,
+                val_l,
+                q_chunk=qc,
+                chunk_table=jnp.clip(ct - poff, 0, local_pages - 1),
+                chunk_start=cs,
+                groups=g_l,
+                window=window,
+                interpret=interpret,
+            )
+            # Position 0's page identifies the chunk's owner shard (the
+            # admitting slot's pool); the other shards folded local
+            # garbage under the same masks and are zeroed exactly.
+            owner = (ct[0] >= poff) & (ct[0] < poff + local_pages)
+            out_chunk = jax.lax.psum(
+                jnp.where(owner, out_chunk, jnp.zeros_like(out_chunk)),
+                "data",
+            )
+            return out_dec, out_chunk
+        return ragged_paged_attention(
+            q_l,
+            kp_l,
+            vp_l,
+            tbl,
+            val_l,
+            groups=g_l,
+            window=window,
+            interpret=interpret,
+        )
+
+    return shard_map(
+        fn, mesh, in_specs=tuple(in_specs), out_specs=out_specs
+    )(*args)
+
+
 # -- thin wrappers: the pre-ragged kernel family ----------------------------
 #
 # Everything below is signature-compatible with the kernels it replaced

@@ -690,6 +690,26 @@ PROGRAM_MBU = REGISTRY.gauge(
 
 
 # ---------------------------------------------------------------------------
+# Mesh-native serving (PR 13).
+# ---------------------------------------------------------------------------
+
+#: The continuous batcher's serving mesh topology, labeled
+#: ``axis="data"`` (slot/page-pool shards — each data shard owns a
+#: contiguous slot block and its page range) and ``axis="model"``
+#: (tensor-parallel shards — kv heads and the MLP hidden split). 1 on
+#: both axes = a single-chip batcher. Purely descriptive: every
+#: serving feature (fused ragged dispatch, grouped prefix attention,
+#: multi-round decode, speculative decoding, the host KV tier) engages
+#: at any value since PR 13 — the README Serving engage matrix is the
+#: authoritative table. Mirrored in the batcher's stats() as
+#: ``mesh_data_shards`` / ``mesh_model_shards`` (lockstep tested).
+MESH_SHARDS = REGISTRY.gauge(
+    "gateway_mesh_shards",
+    "Serving mesh shard count by axis (1 = unsharded)",
+)
+
+
+# ---------------------------------------------------------------------------
 # Canonical manifest of families created on PER-INSTANCE registries
 # (gateway/admission accept an isolated MetricsRegistry for test
 # isolation, so their families cannot be module-level objects here).

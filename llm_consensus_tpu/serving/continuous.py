@@ -59,6 +59,19 @@ style, re-founded on XLA's compile-once constraint:
   Depth 1 is the serialized parity baseline; outputs are
   byte-identical at every depth (tested).
 
+- **Mesh-native hot path** (PR 13): pass ``mesh=`` and the WHOLE stack
+  shards — pool pages and slot blocks over ``data`` (one host
+  allocator + prefix registry per data shard, so every row's table is
+  shard-local), kv heads over ``model``, params via ``shard_params``,
+  the draft pool with the target's — and every feature above plus
+  fused dispatch, grouped prefix attention, multi-round decode, spec
+  decode, and the host tier ENGAGES, serving byte-identical text to
+  the single-chip batcher (tests/test_mesh_serving.py parity grid;
+  README Serving engage matrix). The Pallas ragged kernel runs under
+  shard_map with per-shard page-id rebasing; configs it can't shard
+  (``transformer.ragged_mesh_shardable``) take the GSPMD-sharded XLA
+  reference instead — the one remaining kernel-level fallback.
+
 Pages for the whole request (prompt + max_new_tokens) are reserved at
 admission; requests wait while the pool is exhausted (no mid-flight
 growth/preemption in v1 — simpler, and cannot deadlock; prefix-registry
@@ -126,6 +139,9 @@ from llm_consensus_tpu.models.transformer import (
     program_hbm_cost,
     unembed_one,
     verify_step_paged,
+)
+from llm_consensus_tpu.models.transformer import (
+    ragged_mesh_shardable as _ragged_mesh_shardable,
 )
 from llm_consensus_tpu.server.metrics import (
     PREFILL_STALL_SECONDS as _M_PREFILL_STALL,
@@ -225,6 +241,9 @@ from llm_consensus_tpu.server.metrics import (
 )
 from llm_consensus_tpu.server.metrics import (
     PROGRAM_MBU as _M_PROGRAM_MBU,
+)
+from llm_consensus_tpu.server.metrics import (
+    MESH_SHARDS as _M_MESH_SHARDS,
 )
 from llm_consensus_tpu.utils import tracing as _tracing
 
@@ -330,9 +349,10 @@ class ContinuousConfig:
     # decode rows', and its host bookkeeping (readiness flips,
     # activation, first-token sampling) moves into the pipeline's
     # fetch path, so chunked prefill stops serializing against decode
-    # and stops forcing a per-chunk device sync. Engages off-mesh with
+    # and stops forcing a per-chunk device sync. Engages with
     # prefill_chunk > 0 on BOTH kernel paths (the non-Pallas side runs
-    # the same ragged semantics via the XLA reference). False = the
+    # the same ragged semantics via the XLA reference) and on every
+    # topology — meshes included since PR 13. False = the
     # PR 6/7 behavior: one standalone chunk program between decode
     # steps (the bench's A/B baseline; outputs byte-identical either
     # way). Read per loop iteration — flipping it between bursts needs
@@ -359,8 +379,11 @@ class ContinuousConfig:
     # residual correction (engine/accept.py). spec_k feeds the
     # page-overshoot budget of every admission, so it must not be
     # flipped live — ``spec_decode`` below is the A/B lever. Engages
-    # off-mesh with steps_per_sync == 1 (the verify round IS the
-    # multi-token step).
+    # with steps_per_sync == 1 (the verify round IS the multi-token
+    # step), meshes included since PR 13: the draft pool shards with
+    # the target's (pages over data, heads over model where they
+    # divide) and the draft/verify/accept program runs under GSPMD
+    # like the plain step.
     spec_k: int = 0
     # Live on/off lever for speculation, read per loop iteration (the
     # bench flips THIS between bursts on one batcher; a flip drains the
@@ -383,9 +406,10 @@ class ContinuousConfig:
     # host's byte-level check at fetch stays authoritative (a false
     # positive resumes next window; a miss is trimmed on fetch) — and
     # a request whose stops admit no bounded screen collapses the
-    # window to 1 round while it decodes. Engages off-mesh with
-    # steps_per_sync == 1 (the legacy multi-step chunk has no masking
-    # and stays the tunnel-RTT knob); while speculation is engaged the
+    # window to 1 round while it decodes. Engages with
+    # steps_per_sync == 1, meshes included since PR 13 (the legacy
+    # multi-step chunk has no masking and stays the tunnel-RTT knob);
+    # while speculation is engaged the
     # verify round IS the multi-token step, so spec windows keep one
     # verify round per dispatch and multi-round applies to the plain
     # windows — the two compose by decoupling fetch cadence from the
@@ -615,27 +639,24 @@ class ContinuousBatcher:
                     f"draft vocab {dcfg.vocab_size} != target vocab "
                     f"{cfg.vocab_size} — speculation needs one tokenizer"
                 )
-            if mesh is not None:
+            if c.steps_per_sync > 1:
+                # Not an error: spec_decode is a live lever and the
+                # draft pool/prefills are still maintained — but a
+                # config that can never verify pays the full draft
+                # cost (HBM planes + one mirror program per chunk)
+                # for zero speedup, silently. This is the ONE
+                # remaining no-engage condition: since PR 13 the
+                # draft pool shards with the target's and speculation
+                # engages on meshes too.
                 log.warning(
-                    "speculative decoding does not engage on a mesh "
-                    "(open item 1's sharding refactor); draft ignored"
+                    "speculative decoding engages only with "
+                    "steps_per_sync == 1 (got %d): the draft will "
+                    "prefill but no verify round will ever "
+                    "dispatch",
+                    c.steps_per_sync,
                 )
-            else:
-                if c.steps_per_sync > 1:
-                    # Not an error: spec_decode is a live lever and the
-                    # draft pool/prefills are still maintained — but a
-                    # config that can never verify pays the full draft
-                    # cost (HBM planes + one mirror program per chunk)
-                    # for zero speedup, silently.
-                    log.warning(
-                        "speculative decoding engages only with "
-                        "steps_per_sync == 1 (got %d): the draft will "
-                        "prefill but no verify round will ever "
-                        "dispatch",
-                        c.steps_per_sync,
-                    )
-                self._draft_cfg = dcfg
-                self._draft_params = dparams
+            self._draft_cfg = dcfg
+            self._draft_params = dparams
         # ``mesh``: run the serving hot loop sharded — slots (the decode
         # batch axis) and the page pool's page axis over ``data``, kv
         # heads over ``model``, params via ``shard_params`` (tp over
@@ -644,11 +665,9 @@ class ContinuousBatcher:
         # reads/writes stay shard-local on real hardware.
         self.mesh = mesh
         self._dp = 1
+        self._mp = 1
         self._row_sharding = None
         if mesh is not None:
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-
             from llm_consensus_tpu.parallel.partitioning import shard_params
 
             dp = int(mesh.shape.get("data", 1))
@@ -658,40 +677,65 @@ class ContinuousBatcher:
                     f"must be multiples of the mesh data axis ({dp})"
                 )
             self._dp = dp
+            self._mp = int(mesh.shape.get("model", 1))
             self.params = shard_params(self.params, mesh)
-            self._row_sharding = NamedSharding(mesh, P("data"))
-            self._pool_sharding = PagedKVCache(
-                k=NamedSharding(mesh, P(None, "data", None, "model", None)),
-                v=NamedSharding(mesh, P(None, "data", None, "model", None)),
-                page_table=NamedSharding(mesh, P("data", None)),
-                length=NamedSharding(mesh, P("data")),
-            )
-        if c.decode_rounds > 1 and (c.steps_per_sync > 1 or mesh is not None):
+            if self._draft_params is not None:
+                # The draft shards exactly like the target (PR 13): tp
+                # over ``model``, replicated over ``data`` — the spec
+                # program's draft scan and verify rows run on the same
+                # mesh as the plain decode step.
+                self._draft_params = shard_params(self._draft_params, mesh)
+            self._row_sharding = self._named(("data",))
+            if cfg.use_pallas and not _ragged_mesh_shardable(
+                cfg, mesh, c.max_slots, c.n_pages
+            ):
+                # Every serving feature still ENGAGES — this is purely
+                # the kernel-vs-reference choice inside the one
+                # attention seam (models.transformer._attn_paged).
+                log.warning(
+                    "Pallas ragged kernel cannot shard over this mesh "
+                    "(n_kv_heads=%d %% model=%d, or slots/pages %% "
+                    "data=%d, indivisible): paged attention runs the "
+                    "XLA reference under GSPMD instead — outputs "
+                    "identical, kernel bandwidth shaping lost",
+                    cfg.n_kv_heads,
+                    self._mp,
+                    self._dp,
+                )
+        _M_MESH_SHARDS.labels(axis="data").set(self._dp)
+        _M_MESH_SHARDS.labels(axis="model").set(self._mp)
+        if c.decode_rounds > 1 and c.steps_per_sync > 1:
             # Not an error (the batcher serves correctly either way),
             # but the config still pays decode_rounds into every
             # admission's page-overshoot budget (_round_tokens reads
             # the CONFIG so live flips stay budgeted) while _rounds
             # never engages — capacity spent for zero benefit needs a
-            # signal, exactly like the spec warning above.
+            # signal, exactly like the spec warning above. (Since
+            # PR 13 meshes engage multi-round decode like single
+            # chips; steps_per_sync > 1 is the one remaining
+            # no-engage condition.)
             log.warning(
                 "decode_rounds=%d never engages with steps_per_sync=%d"
-                "%s: no multi-round program will dispatch, but the "
+                ": no multi-round program will dispatch, but the "
                 "page-overshoot budget still reserves for R rounds",
                 c.decode_rounds,
                 c.steps_per_sync,
-                " on a mesh" if mesh is not None else "",
             )
         self.cache = PagedKVCache.create(
             cfg, c.n_pages, c.page_size, c.max_slots, c.pages_per_seq
         )
         if mesh is not None:
-            self.cache = jax.device_put(self.cache, self._pool_sharding)
+            self.cache = jax.device_put(
+                self.cache, self._pool_sharding_for(cfg)
+            )
         if self._draft_cfg is not None:
             # The draft pool: same n_pages/page_size/table geometry as
             # the target's, its own [L_d, n, page, Hkv_d, D_d] planes.
             # page_table/length are maintained in LOCKSTEP with the
             # target cache at every install/release/assign site, so one
-            # host allocator serves both pools.
+            # host allocator serves both pools. On a mesh it takes the
+            # same placement as the target's (pages over ``data``,
+            # heads over ``model`` where they divide).
             self.draft_cache = PagedKVCache.create(
                 self._draft_cfg,
                 c.n_pages,
@@ -699,6 +743,11 @@ class ContinuousBatcher:
                 c.max_slots,
                 c.pages_per_seq,
             )
+            if mesh is not None:
+                self.draft_cache = jax.device_put(
+                    self.draft_cache,
+                    self._pool_sharding_for(self._draft_cfg),
+                )
         # Host-side refcounted page allocator; page 0 is the NULL page.
         # On a mesh, one pool (and one prefix registry) per data shard:
         # slot s (slots shard in contiguous blocks) draws only from its
@@ -720,18 +769,20 @@ class ContinuousBatcher:
         self._registries = [
             PrefixRegistry(pool, c.page_size) for pool in self._pools
         ]
-        # Host-RAM offload tier (PR 4). Engages only on the chunked
-        # shared-prefix path (restores re-register under the registry's
-        # readiness gates) and off-mesh: a sharded pool's page planes
-        # would device_get/install across the data axis, a transfer
-        # pattern nothing exercises yet — the documented fallback is
-        # plain eviction, exactly the PR 2/3 behavior (README Serving).
+        # Host-RAM offload tier (PR 4; mesh-native since PR 13).
+        # Engages only on the chunked shared-prefix path (restores
+        # re-register under the registry's readiness gates). On a mesh
+        # the demote ``device_get`` assembles the page's sharded plane
+        # slices into one host buffer and the restore ``install_page``
+        # scatters it back through the pool's NamedSharding — the
+        # round trip is bit-identical either way (tested); per-shard
+        # streaming of the slices is a chip-transport optimization the
+        # correctness contract doesn't depend on.
         self._offload: HostPageStore | None = None
         if (
             c.host_cache_bytes > 0
             and c.share_prefix
             and c.prefill_chunk > 0
-            and mesh is None
         ):
             self._offload = HostPageStore(c.host_cache_bytes)
             for reg in self._registries:
@@ -745,16 +796,30 @@ class ContinuousBatcher:
         self._offload_restored = 0
         # Group-aware decode attention: derive per-step groups from
         # shared prefix page runs. The ragged kernel handles groups,
-        # sliding windows, and mixed rows in one program, so the only
-        # remaining engage conditions are the kernel's own (use_pallas,
-        # no mesh) plus the feature knobs — the PR 3 sliding-window
-        # fallback is gone (README Serving).
+        # sliding windows, and mixed rows in one program, and since
+        # PR 13 meshes too (shard_map with groups riding their
+        # members' data shard), so the only remaining engage
+        # conditions are use_pallas plus the feature knobs — the PR 3
+        # sliding-window fallback and the mesh fallback are both gone
+        # (README Serving engage matrix). Grouping is per data shard
+        # by construction: pages share only within one shard's
+        # registry, so a group's members always land on one shard. On
+        # a mesh the KERNEL must actually be shardable: the XLA
+        # reference fallback ignores groups, so building them would
+        # only accrue shared-KV "savings" that never happen (and pay
+        # the per-iteration tracker work) — telemetry must not claim
+        # reads the program still performs.
         self._group_decode = (
             c.prefix_attention
             and c.share_prefix
             and c.prefill_chunk > 0
             and cfg.use_pallas
-            and mesh is None
+            and (
+                mesh is None
+                or _ragged_mesh_shardable(
+                    cfg, mesh, c.max_slots, c.n_pages
+                )
+            )
         )
         self._groups = GroupTracker(c.max_slots, c.page_size)
         # KV bytes one token costs per read across all layers (k + v,
@@ -935,6 +1000,29 @@ class ContinuousBatcher:
         )
         self._thread.start()
 
+    def _named(self, spec) -> "object":
+        """NamedSharding over this batcher's mesh for an axis tuple."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(*spec))
+
+    def _pool_sharding_for(self, cfg: ModelConfig) -> PagedKVCache:
+        """Placement of one paged pool on the mesh (PR 13): pages over
+        ``data`` (each data shard holds exactly the page range its
+        slots allocate from — the host allocator's affinity), kv heads
+        over ``model`` when they divide (a draft whose Hkv < mp
+        replicates its heads — tiny planes, correctness first), page
+        tables and lengths row-sharded over ``data``."""
+        head = "model" if cfg.n_kv_heads % self._mp == 0 else None
+        plane = self._named((None, "data", None, head, None))
+        return PagedKVCache(
+            k=plane,
+            v=plane,
+            page_table=self._named(("data", None)),
+            length=self._named(("data",)),
+        )
+
     @property
     def _sync_chunk(self) -> int:
         """Decode steps per dispatched device program (>= 1) — THE one
@@ -968,20 +1056,18 @@ class ContinuousBatcher:
     @property
     def _rounds(self) -> int:
         """Decode rounds folded into one PLAIN (non-spec) dispatch
-        (PR 12) — ``decode_rounds`` when engaged, else 1. Engages
-        off-mesh with steps_per_sync == 1: the legacy multi-step chunk
-        is unmasked (and the mesh path would scatter frozen rows'
-        NULL-page writes across the data axis — open item 1's sharding
-        refactor). Read per loop iteration (the bench's A/B lever);
-        while > 1 every non-spec dispatch runs the multi-round
-        machinery — even a stop-bound 1-round window — so a pipeline
-        window never mixes host- and device-advanced PRNG counts."""
+        (PR 12) — ``decode_rounds`` when engaged, else 1. Engages with
+        steps_per_sync == 1 (the legacy multi-step chunk is unmasked),
+        meshes included since PR 13: a frozen row's NULL-page write is
+        one more row of the same sharded scatter every live row rides,
+        and the stop screen / budgets / emit counts are per-row data
+        sharded over ``data`` like every other row array. Read per
+        loop iteration (the bench's A/B lever); while > 1 every
+        non-spec dispatch runs the multi-round machinery — even a
+        stop-bound 1-round window — so a pipeline window never mixes
+        host- and device-advanced PRNG counts."""
         c = self.config
-        if (
-            c.decode_rounds <= 1
-            or self._sync_chunk != 1
-            or self.mesh is not None
-        ):
+        if c.decode_rounds <= 1 or self._sync_chunk != 1:
             return 1
         return c.decode_rounds
 
@@ -1074,7 +1160,7 @@ class ContinuousBatcher:
                 cache, tok, cnt, alive, emitted = carry
             logits, cache = decode_step_paged(
                 self.cfg, params, tok[:, None], cache, groups=groups,
-                write_mask=alive,
+                write_mask=alive, mesh=self.mesh,
             )
             keys = jax.vmap(
                 lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
@@ -1209,6 +1295,7 @@ class ContinuousBatcher:
             chunk_start,
             groups=groups,
             cfg_chunk=cfg_chunk,
+            mesh=self.mesh,
         )
         keys = jax.vmap(
             lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
@@ -1329,7 +1416,9 @@ class ContinuousBatcher:
 
         def dbody(carry, j):
             dc, tok, hist = carry
-            lg, dc = decode_step_paged(dcfg, dparams, tok[:, None], dc)
+            lg, dc = decode_step_paged(
+                dcfg, dparams, tok[:, None], dc, mesh=self.mesh
+            )
             prop = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B]
             hist = hist.at[:, j].set(prop)
             # Next input = each row's stream token j: donor committed
@@ -1361,7 +1450,8 @@ class ContinuousBatcher:
 
         vtok = jnp.concatenate([tokens[:, None], drafts], axis=1)
         logits, cache = verify_step_paged(
-            self._spec_cfg, params, vtok, cache, groups=groups
+            self._spec_cfg, params, vtok, cache, groups=groups,
+            mesh=self.mesh,
         )  # [B, K+1, V] fp32
 
         def row_keys(s, c):
@@ -1500,7 +1590,8 @@ class ContinuousBatcher:
         if key not in self._jit_chunk:
             cfg = self.cfg.moe_pin_for(s_bucket, chunk)
             self._jit_chunk[key] = jax.jit(
-                partial(prefill_chunk_paged, cfg), donate_argnums=(4,)
+                partial(prefill_chunk_paged, cfg, mesh=self.mesh),
+                donate_argnums=(4,),
             )
         return self._jit_chunk[key]
 
@@ -1514,7 +1605,8 @@ class ContinuousBatcher:
         if key not in self._jit_chunk_d:
             dcfg = self._draft_cfg.moe_pin_for(s_bucket, chunk)
             self._jit_chunk_d[key] = jax.jit(
-                partial(prefill_chunk_paged, dcfg), donate_argnums=(4,)
+                partial(prefill_chunk_paged, dcfg, mesh=self.mesh),
+                donate_argnums=(4,),
             )
         return self._jit_chunk_d[key]
 
@@ -1648,15 +1740,18 @@ class ContinuousBatcher:
     @property
     def _fused_ok(self) -> bool:
         """Whether a ready chunk may ride the decode dispatch this
-        iteration (PR 8). Off-mesh only — the fused program's concat
-        token axis mixes the data-sharded decode rows with the chunk's
-        tokens, a layout the mesh path doesn't support (open item 1's
-        sharding refactor). Read per iteration: the bench flips
-        ``config.ragged_attention`` between bursts on one batcher."""
+        iteration (PR 8; mesh-native since PR 13). On a mesh the fused
+        program's concat [B + C] token axis is laid out by GSPMD from
+        the operands' shardings — decode rows over ``data``, the chunk
+        lane riding replicated with its K/V scatter landing on the
+        owner shard's page range — and the attention read goes through
+        the same one kernel seam as the plain step, so ONE device
+        program per scheduler iteration holds on every topology. Read
+        per iteration: the bench flips ``config.ragged_attention``
+        between bursts on one batcher."""
         return (
             self.config.ragged_attention
             and self.config.prefill_chunk > 0
-            and self.mesh is None
         )
 
     # -- public API -----------------------------------------------------
@@ -1865,6 +1960,12 @@ class ContinuousBatcher:
                 "device_rounds_total": self._device_rounds,
                 "decode_rounds_sum": self._decode_rounds_sum,
                 "decode_rounds_count": self._decode_rounds_count,
+                # Mesh topology (PR 13) — the same numbers behind
+                # gateway_mesh_shards{axis} (lockstep tested): 1 on a
+                # single chip; the serving features engage either way
+                # (README engage matrix).
+                "mesh_data_shards": self._dp,
+                "mesh_model_shards": self._mp,
                 # Speculative decoding (PR 9) — the same observations
                 # behind gateway_spec_draft_tokens_total /
                 # gateway_spec_accepted_tokens_total /

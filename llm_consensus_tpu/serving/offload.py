@@ -34,6 +34,15 @@ recompute, which is always correct).
 Host-side only and jax-free on the hot paths (plain numpy + an
 OrderedDict); the batcher owns the device transfers. Not thread-safe —
 the continuous batcher's worker owns it, like the pools/registries.
+
+Mesh-native since PR 13: on a dp×mp mesh the demote ``device_get``
+assembles a page's sharded plane slices into one host buffer and the
+restore ``install_page`` scatters it back through the pool's
+NamedSharding — the round trip stays bit-identical (tested on
+dp2×mp2), and the store itself is topology-blind (it only ever sees
+host numpy planes). Per-shard streaming of the slices is a
+chip-transport optimization the correctness contract doesn't depend
+on.
 """
 
 from __future__ import annotations
