@@ -368,8 +368,32 @@ def main() -> int:
         "--serve-host-cache-mb",
         type=int,
         default=256,
-        help="host-RAM KV tier byte budget for --serve-offload "
+        help="host-RAM KV tier byte budget for --serve-offload and "
+        "the --serve-replicas fleet store "
         "(ContinuousConfig.host_cache_bytes, in MiB)",
+    )
+    p.add_argument(
+        "--serve-replicas",
+        type=int,
+        default=0,
+        help="replica-fleet A/B leg (PR 14): the PR-8 mixed panel "
+        "burst (half sharing one header, half unique) served through "
+        "K prefix-affinity-routed batcher replicas vs a K-replica "
+        "random-routing control — gates the affinity leg's prefix "
+        "hit rate STRICTLY above the control's and per-pair "
+        "byte-identical text — then an overload-storm sub-leg "
+        "through one gateway (queue bound far below the storm) "
+        "gating ZERO 429s while preemption is possible: resident "
+        "chains demote to the fleet-shared host tier "
+        "(--serve-host-cache-mb) and the re-vote wave restores them "
+        "(0 lost requests). 0 = leg off; pass K >= 2",
+    )
+    p.add_argument(
+        "--serve-storm-requests",
+        type=int,
+        default=0,
+        help="--serve-replicas overload sub-leg storm size "
+        "(concurrent gateway requests; 0 = 2x --serve-requests)",
     )
     p.add_argument(
         "--serve-decode-pipeline",
@@ -734,6 +758,8 @@ def main() -> int:
         return _bench_serving_trace_overhead(args, cfg, params)
     if args.serve_flight_overhead:
         return _bench_serving_flight_overhead(args, cfg, params)
+    if args.serve_replicas:
+        return _bench_serving_replicas(args, cfg, params)
     if args.serve_offload:
         return _bench_serving_offload(args, cfg, params)
     if args.serve_prefix_attention:
@@ -2513,6 +2539,291 @@ def _bench_serving_flight_overhead(args, cfg, params) -> int:
         )
         return 1
     return 0
+
+
+def _bench_serving_replicas(args, cfg, params) -> int:
+    """Replica-fleet A/B (PR 14): prefix-affinity routing vs a
+    random-routing control, then an overload storm through one gateway
+    gating preemption-instead-of-429s.
+
+    Leg A — the PR-8 mixed panel burst (half the requests share one
+    multi-page header, half are unique from byte 0) served through a
+    K-replica :class:`ReplicaSet` twice: routing policy "prefix" (the
+    subsystem) vs "random" (round-robin control). Affinity lands the
+    panel's mates where the header's chain lives, so its registry hit
+    rate must be STRICTLY above the control's (which scatters the
+    panel and re-prefills the header per replica); generated text is
+    REQUIRED byte-identical per pair (routing must never change
+    output — requests are seeded and batch-independent).
+
+    Leg B — the overload storm: a fleet with working-set-starved pools
+    behind one gateway whose admission queue bound sits far below the
+    storm size. Wave 1 primes a header; the storm wave (a different
+    header) overflows the queue on most submits — the fleet's
+    overflow hook preempts resident chains to the fleet-shared host
+    tier instead of shedding; the re-vote wave re-sends wave 1's
+    header, which restores from the tier. Gates: ZERO 429s, every
+    storm request completes with text, >= 1 router-requested
+    preemption, >= 1 restored chain page.
+    """
+    from llm_consensus_tpu.server import metrics as _metrics
+    from llm_consensus_tpu.server.admission import AdmissionConfig
+    from llm_consensus_tpu.server.client import (
+        GatewayClient,
+        GatewayHTTPError,
+    )
+    from llm_consensus_tpu.server.gateway import (
+        Gateway,
+        GatewayConfig,
+        GatewayThread,
+    )
+    from llm_consensus_tpu.serving.continuous import ContinuousConfig
+    from llm_consensus_tpu.serving.fleet import (
+        FleetBackend,
+        FleetConfig,
+        ReplicaSet,
+    )
+
+    k = args.serve_replicas
+    if k < 2:
+        print(
+            f"[bench] --serve-replicas needs K >= 2, got {k}",
+            file=sys.stderr,
+        )
+        return 2
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    header = f"Fleet header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    n = args.serve_requests
+    uniq_pad = "distinct traffic padding " * (-(-header_target // 25))
+    # Mixed burst, panel mates FIRST: the random control is
+    # round-robin, so a shared-first order deterministically scatters
+    # the panel across replicas (mates alternate) — the control's hit
+    # rate sits strictly below affinity's by construction, no
+    # coin-flip tie to flake the gate. The affinity leg is
+    # order-independent (the router probes resident chains).
+    prompts = [
+        header + f"Q{i}: propose for item {i * 37 % 101}"
+        for i in range(n // 2)
+    ] + [f"{i} unique {salt}: " + uniq_pad for i in range(n - n // 2)]
+    longest = max(len(p) for p in prompts) + 1
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], args.new_tokens, args.serve_chunk, pg
+    )
+    host_bytes = args.serve_host_cache_mb << 20
+
+    def fleet_config(n_pages):
+        return ContinuousConfig(
+            max_slots=args.serve_slots,
+            page_size=pg,
+            n_pages=n_pages,
+            pages_per_seq=pages_per_seq,
+            max_new_tokens=args.new_tokens,
+            seq_buckets=tuple(buckets),
+            steps_per_sync=args.serve_chunk,
+            prefill_chunk=args.serve_prefill_chunk or 64,
+            share_prefix=True,
+            host_cache_bytes=host_bytes,
+        )
+
+    def warm(fleet):
+        # One warmup per replica: each compiles its own programs.
+        futs = [
+            fleet.submit_to(
+                i, f"warmup {salt} r{i} " + "ctx " * (header_target // 5),
+                max_new_tokens=args.new_tokens,
+            )
+            for i in range(k)
+        ]
+        for f in futs:
+            f.result(timeout=600)
+
+    def run(policy):
+        # Pool sized ABOVE the burst working set: leg A isolates
+        # routing, so eviction pressure stays out of it.
+        fleet = ReplicaSet(
+            cfg,
+            params,
+            config=fleet_config(1 + args.serve_slots * pages_per_seq * 2),
+            fleet=FleetConfig(replicas=k, policy=policy),
+        )
+        try:
+            warm(fleet)
+            t0 = time.perf_counter()
+            futs = [
+                fleet.submit(p, max_new_tokens=args.new_tokens)
+                for p in prompts
+            ]
+            results = [f.result(timeout=600) for f in futs]
+            wall = time.perf_counter() - t0
+            toks = sum(r.num_tokens for r in results)
+            stats = fleet.stats()
+        finally:
+            fleet.close()
+        return [r.text for r in results], toks / wall, stats
+
+    texts_aff, tps_aff, s_aff = run("prefix")
+    texts_rand, tps_rand, s_rand = run("random")
+    text_equal = texts_aff == texts_rand
+    hit_aff = s_aff["prefix_hit_rate"]
+    hit_rand = s_rand["prefix_hit_rate"]
+
+    # -- leg B: the overload storm through one gateway ------------------
+    storm_n = args.serve_storm_requests or 2 * n
+    prime_n = max(2, args.serve_slots)
+    fleet = ReplicaSet(
+        cfg,
+        params,
+        # Working-set-starved pools (the offload leg's trick): chains
+        # cannot stay device-resident across waves, so preemption and
+        # pool-pressure demotion have real work to do.
+        config=fleet_config(1 + args.serve_slots * pages_per_seq),
+        fleet=FleetConfig(replicas=k, policy="prefix"),
+    )
+    backend = FleetBackend(fleet)
+    gw = GatewayThread(
+        Gateway(
+            backend,
+            config=GatewayConfig(
+                port=0,
+                admission=AdmissionConfig(
+                    # Bound far below the storm: most storm submits
+                    # find the queue full and take the preempt path.
+                    max_queue=2,
+                    max_inflight=2,
+                ),
+            ),
+        )
+    ).start()
+    shed_before = sum(
+        v
+        for kk, v in _metrics.REGISTRY.snapshot().items()
+        if kk.startswith("gateway_shed_total")
+    )
+    # Failures collected per thread via list.append (atomic); the 429
+    # tally is derived AFTER the joins — a nonlocal int += across
+    # storm threads would race and undercount.
+    errors: list[str] = []
+
+    def storm_call(client, prompt):
+        try:
+            r = client.generate(
+                prompt, max_new_tokens=args.new_tokens, temperature=0.0
+            )
+            if not isinstance(r.get("text"), str):
+                errors.append(f"no text: {r}")
+        except GatewayHTTPError as e:
+            errors.append(f"HTTP {e.status}")
+        except Exception as e:  # noqa: BLE001 - counted, not raised
+            errors.append(repr(e))
+
+    import threading as _threading
+
+    try:
+        warm(fleet)
+        client = GatewayClient("127.0.0.1", gw.port, timeout=600.0)
+        h1 = f"Storm header A {salt}: " + "shared context " * (
+            -(-header_target // 15)
+        )
+        h2 = f"Storm header B {salt}: " + "shared context " * (
+            -(-header_target // 15)
+        )
+        waves = [
+            [h1 + f"P{i}: prime" for i in range(prime_n)],
+            [
+                h2 + f"S{i}: storm item {i * 37 % 101}"
+                for i in range(storm_n)
+            ],
+            [h1 + f"R{i}: re-vote" for i in range(prime_n)],
+        ]
+        completed = 0
+        t0 = time.perf_counter()
+        for wave in waves:
+            threads = [
+                _threading.Thread(target=storm_call, args=(client, p))
+                for p in wave
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            completed += len(wave)
+        storm_wall = time.perf_counter() - t0
+        storm_stats = fleet.stats()
+    finally:
+        gw.drain()
+        fleet.close()
+    shed_after = sum(
+        v
+        for kk, v in _metrics.REGISTRY.snapshot().items()
+        if kk.startswith("gateway_shed_total")
+    )
+    shed = shed_after - shed_before
+    e429 = sum(1 for e in errors if e == "HTTP 429")
+    preempts = sum(storm_stats["preempt_requests"])
+    restored = storm_stats["offload_restored_pages"]
+    demoted = storm_stats["offload_demoted_pages"]
+    lost = len(errors)
+
+    gate_hit = hit_aff > hit_rand
+    gate_storm = shed == 0 and e429 == 0 and lost == 0
+    gate_preempt = preempts >= 1 and restored >= 1
+    status = (
+        "ok"
+        if (text_equal and gate_hit and gate_storm and gate_preempt)
+        else "failed"
+    )
+    _emit(
+        {
+            "metric": f"serving tok/s, prefix-affinity replica fleet "
+            f"({cfg.name}, K={k}, {n} mixed reqs, slots="
+            f"{args.serve_slots}/replica, decode {args.new_tokens} @ "
+            f"~{header_target} header, hit-rate affinity "
+            f"{hit_aff:.3f} vs random {hit_rand:.3f}, routed prefix "
+            f"{s_aff['routed_prefix']}/{s_aff['routed_total']}, "
+            f"random-control {tps_rand:.0f} tok/s, storm "
+            f"{storm_n}+2x{prime_n} reqs in {storm_wall:.1f}s: "
+            f"429s {e429}, shed {shed}, lost {lost}, preempts "
+            f"{preempts}, demoted {demoted} / restored {restored} "
+            f"pages, text unchanged={text_equal})",
+            "value": round(tps_aff, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(tps_aff / max(tps_rand, 1e-9), 4),
+            "status": status,
+        },
+        args.out,
+    )
+    if not text_equal:
+        print(
+            "[bench] GENERATED TEXT DIVERGED between affinity and "
+            "random routing — routing must never change output",
+            file=sys.stderr,
+        )
+    if not gate_hit:
+        print(
+            f"[bench] affinity hit rate {hit_aff:.3f} NOT above "
+            f"random-routing control {hit_rand:.3f}",
+            file=sys.stderr,
+        )
+    if not gate_storm:
+        print(
+            f"[bench] overload storm lost work: {e429} x 429, shed "
+            f"{shed}, {lost} failures ({errors[:5]})",
+            file=sys.stderr,
+        )
+    if not gate_preempt:
+        print(
+            f"[bench] storm never exercised preemption (preempts "
+            f"{preempts}, restored {restored}) — sizing regression",
+            file=sys.stderr,
+        )
+    return 0 if status == "ok" else 1
 
 
 def _bench_serving_offload(args, cfg, params) -> int:

@@ -169,22 +169,57 @@ def _build_backend(args):
                 "--draft-model on --backend continuous needs --spec-k > 0 "
                 "(draft tokens proposed per verify round)"
             )
+        serve_config = ContinuousConfig(
+            max_slots=args.serve_slots,
+            max_new_tokens=args.max_new_tokens,
+            prefill_chunk=args.prefill_chunk,
+            share_prefix=not args.no_share_prefix,
+            host_cache_bytes=args.host_cache_mb << 20,
+            pipeline_depth=args.pipeline_depth,
+            ragged_attention=not args.no_ragged_attention,
+            spec_k=args.spec_k if draft is not None else 0,
+            decode_rounds=args.decode_rounds,
+            hbm_gbps=args.hbm_gbps,
+        )
+        if args.replicas > 1:
+            # Prefix-affinity replica fleet (PR 14): K batchers behind
+            # the one gateway, routed by resident-chain affinity with
+            # preempt-to-host-tier under overload. --host-cache-mb
+            # budgets the ONE fleet-shared store.
+            from llm_consensus_tpu.serving.fleet import (
+                FleetBackend,
+                FleetConfig,
+                ReplicaSet,
+            )
+
+            return FleetBackend(
+                ReplicaSet(
+                    cfg,
+                    params,
+                    tokenizer=load_tokenizer(args.tokenizer),
+                    config=serve_config,
+                    fleet=FleetConfig(
+                        replicas=args.replicas,
+                        # Keep the router's wedged-replica threshold in
+                        # lockstep with the gateway's /readyz one: two
+                        # independent defaults would let /readyz report
+                        # a replica wedged while the router still
+                        # routes to it (or vice versa). The main
+                        # parser has no --ready-stall-s; fall back to
+                        # the serve default.
+                        ready_stall_s=getattr(
+                            args, "ready_stall_s", 10.0
+                        ),
+                    ),
+                    mesh=mesh,
+                    draft=draft,
+                )
+            )
         batcher = ContinuousBatcher(
             cfg,
             params,
             tokenizer=load_tokenizer(args.tokenizer),
-            config=ContinuousConfig(
-                max_slots=args.serve_slots,
-                max_new_tokens=args.max_new_tokens,
-                prefill_chunk=args.prefill_chunk,
-                share_prefix=not args.no_share_prefix,
-                host_cache_bytes=args.host_cache_mb << 20,
-                pipeline_depth=args.pipeline_depth,
-                ragged_attention=not args.no_ragged_attention,
-                spec_k=args.spec_k if draft is not None else 0,
-                decode_rounds=args.decode_rounds,
-                hbm_gbps=args.hbm_gbps,
-            ),
+            config=serve_config,
             mesh=mesh,
             draft=draft,
         )
@@ -215,6 +250,20 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         default=8,
         help="continuous backend: decode slots (batch width of the "
         "one compiled decode program)",
+    )
+    p.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="continuous backend: batcher replicas behind the one "
+        "gateway (PR 14) — requests route by prefix affinity (a "
+        "request lands on the replica whose registry/host-tier "
+        "already holds its prompt's chain; consensus panels make "
+        "that the common case), fall back to least modeled cost, "
+        "and under overload the fleet preempts resident chains to "
+        "the shared host tier (--host-cache-mb, fleet-wide budget) "
+        "instead of shedding 429s. 1 = a single batcher (the classic "
+        "path)",
     )
     p.add_argument(
         "--prefill-chunk",

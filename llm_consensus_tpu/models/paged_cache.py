@@ -51,6 +51,30 @@ from llm_consensus_tpu.models.configs import ModelConfig
 NULL_PAGE = 0
 
 
+def prefix_chain_key(
+    ids: Sequence[int], page_size: int
+) -> tuple[tuple[int, ...], ...]:
+    """A prompt's page-aligned prefix-chain fingerprint: the tuple of
+    page-sized token runs that key both the :class:`PrefixRegistry`
+    radix walk and the host tier's chain keys — capped at the USABLE
+    full pages (at least the last prompt token is always recomputed,
+    so a prompt's final partial/whole page never participates in
+    sharing; the same ``usable_full`` cap :meth:`PrefixRegistry.match`
+    applies).
+
+    Exported for the replica fleet (PR 14): the router fingerprints a
+    request ONCE and compares it against every replica's resident
+    chains — "requests sharing a radix-registry chain land where the
+    pages already live" needs exactly this identity, computed the same
+    way the registry computes it.
+    """
+    usable_full = (len(ids) - 1) // page_size
+    return tuple(
+        tuple(int(t) for t in ids[k * page_size : (k + 1) * page_size])
+        for k in range(usable_full)
+    )
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class PagedKVCache:
@@ -455,6 +479,35 @@ class PrefixRegistry:
                 match.boundary_page = best_child.page
                 match.boundary_common = min(best, cap)
         return match
+
+    def probe(self, ids: Sequence[int]) -> tuple[list[_PrefixNode], int]:
+        """Read-only longest-prefix walk: which registered nodes cover
+        this prompt's page-aligned prefix, and how many tokens they
+        span. NO side effects — no refcount bumps, no LRU ticks, no
+        hit/lookup counters — so the fleet router (PR 14) can probe
+        every replica per request without perturbing the eviction
+        order or the admission-committed hit statistics that
+        :meth:`match` + :meth:`record_commit` own.
+
+        Unready nodes COUNT: their page identity is established at
+        admission (PR 2), so a concurrent same-prefix burst probes the
+        donor's replica as a match while the donor's prefill is still
+        in flight — exactly the affinity the router needs.
+        """
+        pg = self.page_size
+        node = self._root
+        nodes: list[_PrefixNode] = []
+        usable_full = (len(ids) - 1) // pg
+        k = 0
+        while k < usable_full:
+            key = tuple(int(t) for t in ids[k * pg : (k + 1) * pg])
+            child = node.children.get(key)
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+            k += 1
+        return nodes, k * pg
 
     def record_commit(self, match: PrefixMatch, copied: bool) -> None:
         """Count a match the caller actually ADMITTED on. Kept separate

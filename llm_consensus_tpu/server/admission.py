@@ -33,6 +33,7 @@ cross-checks against observed HTTP outcomes.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from collections import deque
 from collections.abc import Awaitable, Callable
@@ -40,6 +41,8 @@ from dataclasses import dataclass, field
 
 from llm_consensus_tpu.server import metrics as _metrics
 from llm_consensus_tpu.utils import tracing as _tracing
+
+log = logging.getLogger(__name__)
 
 __all__ = [
     "AdmissionConfig",
@@ -86,6 +89,13 @@ class AdmissionConfig:
     # Retry-After hint returned on shed when the queue-wait history is
     # still empty.
     retry_after_s: float = 1.0
+    # Hard ceiling on overflow admission (PR 14): a granting
+    # overflow_hook stretches a priority's queue bound by at most this
+    # factor — preemption absorbs storms, it never REMOVES
+    # backpressure (a stale preempt signal + a mega-storm must
+    # eventually shed fast 429s instead of queueing requests to
+    # deadline death and growing queue memory with offered load).
+    max_overflow_factor: int = 16
 
     def bound_for(self, priority: str) -> int:
         if isinstance(self.max_queue, dict):
@@ -124,6 +134,17 @@ class AdmissionController:
         }
         self._inflight = 0
         self._draining = False
+        # Overload overflow hook (PR 14): consulted at a queue-full
+        # moment BEFORE shedding. Returning True admits the request
+        # past the bound — the fleet's preempt-to-host-tier path
+        # (ReplicaSet.preempt_for_admission) frees backend capacity by
+        # demoting resident KV chains instead of 429ing, so an
+        # overload storm degrades to restore latency, not lost work.
+        # The hook must be cheap and non-blocking (it runs on the
+        # event loop inside submit) and is expected to become False
+        # once nothing is left to preempt — that, not the queue bound,
+        # is then the shed condition. None (default) = classic shed.
+        self.overflow_hook: Callable[[], bool] | None = None
         self._work = asyncio.Event()
         self._idle = asyncio.Event()
         self._idle.set()
@@ -185,9 +206,21 @@ class AdmissionController:
             )
         if self._draining:
             raise DrainingError("gateway is draining; not admitting")
-        if len(q) >= self.config.bound_for(prio):
-            self._m_shed.labels(priority=prio).inc()
-            raise QueueFullError(prio, self._retry_after_hint())
+        bound = self.config.bound_for(prio)
+        if len(q) >= bound:
+            hook = self.overflow_hook
+            preempted = False
+            if (
+                hook is not None
+                and len(q) < bound * self.config.max_overflow_factor
+            ):
+                try:
+                    preempted = bool(hook())
+                except Exception:  # noqa: BLE001 - hook must not 500
+                    log.exception("admission overflow hook failed")
+            if not preempted:
+                self._m_shed.labels(priority=prio).inc()
+                raise QueueFullError(prio, self._retry_after_hint())
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
         now = time.monotonic()

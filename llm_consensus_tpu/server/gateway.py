@@ -178,6 +178,15 @@ class Gateway:
         self.admission = AdmissionController(
             self.config.admission, registry=self.registry
         )
+        # Preempt-instead-of-shed (PR 14): a backend that can free
+        # capacity under overload (the replica fleet demotes resident
+        # KV chains to its shared host tier) exposes
+        # ``preempt_for_admission``; the admission controller consults
+        # it at queue-full moments and admits past the bound while it
+        # returns True — 429s resume only when preemption is exhausted.
+        hook = getattr(backend, "preempt_for_admission", None)
+        if callable(hook):
+            self.admission.overflow_hook = hook
         self._server: asyncio.Server | None = None
         self._conn_tasks: set[asyncio.Task] = set()
         self.port: int | None = None  # actual bound port (ephemeral-safe)
@@ -362,16 +371,36 @@ class Gateway:
             # loop's state is unknown — stop routing traffic here.
             return False, {**doc, "reason": f"health probe failed: {hb['error']}"}
         age = hb.get("last_tick_age_s")
+        # Replica fleet (PR 14): the backend heartbeat aggregates one
+        # entry per batcher replica. The aggregate alive/max-age checks
+        # below already flip readiness when ANY replica wedges (alive
+        # is ANDed, the age is the stalest loop's); here we NAME the
+        # wedged indices so the operator knows which replica to
+        # restart — the router has already stopped sending it traffic.
+        wedged = [
+            i
+            for i, r in enumerate(hb.get("replicas") or [])
+            if not r.get("alive")
+            or (
+                r.get("last_tick_age_s") is not None
+                and r["last_tick_age_s"] > self.config.ready_stall_s
+            )
+        ]
+        if wedged:
+            doc = {**doc, "wedged_replicas": wedged}
         if hb.get("alive") is False:
-            return False, {**doc, "reason": "serving loop dead"}
+            reason = "serving loop dead"
+            if wedged:
+                reason = f"serving loop dead (replicas {wedged})"
+            return False, {**doc, "reason": reason}
         if age is not None and age > self.config.ready_stall_s:
-            return False, {
-                **doc,
-                "reason": (
-                    f"serving loop stalled {age:.1f}s "
-                    f"(> {self.config.ready_stall_s}s)"
-                ),
-            }
+            reason = (
+                f"serving loop stalled {age:.1f}s "
+                f"(> {self.config.ready_stall_s}s)"
+            )
+            if wedged:
+                reason += f" (replicas {wedged})"
+            return False, {**doc, "reason": reason}
         return True, doc
 
     async def _route(self, method, path, headers, body, writer) -> None:

@@ -77,6 +77,11 @@ __all__ = [
     "KV_OFFLOAD_DROPPED",
     "KV_RESTORE_SECONDS",
     "KV_HOST_TIER_BYTES",
+    "REPLICA_ROUTED",
+    "REPLICA_PROGRAMS",
+    "REPLICA_PREFIX_HIT_RATE",
+    "REPLICA_PREEMPTIONS",
+    "REPLICA_SHARED_STORE_BYTES",
 ]
 
 # Seconds: spans ~1 ms .. 2 min, the TTFT / request-latency range of a
@@ -706,6 +711,57 @@ PROGRAM_MBU = REGISTRY.gauge(
 MESH_SHARDS = REGISTRY.gauge(
     "gateway_mesh_shards",
     "Serving mesh shard count by axis (1 = unsharded)",
+)
+
+
+# ---------------------------------------------------------------------------
+# Prefix-affinity replica fleet (PR 14): N continuous-batcher replicas
+# behind one gateway (serving/fleet.py), routed by prefix affinity with
+# preempt-to-host-tier instead of 429s. All labeled ``replica="<idx>"``
+# except the shared-store gauge (the store is fleet-scoped, one per
+# ReplicaSet).
+# ---------------------------------------------------------------------------
+
+#: One increment per routed request, labeled ``replica`` and ``reason``
+#: (``"prefix"`` — the replica held the longest resident chain;
+#: ``"load"`` — no affinity anywhere, least modeled-cost replica won;
+#: ``"rebalance"`` — the affinity owner was congested, the chain was
+#: exported through the shared store and the request re-homed;
+#: ``"random"`` — the bench's control policy). affinity/total is the
+#: routed prefix-affinity rate the --serve-replicas bench leg gates.
+REPLICA_ROUTED = REGISTRY.counter(
+    "gateway_replica_routed_total",
+    "Requests routed to each fleet replica, by routing reason",
+)
+#: Device programs each replica's scheduler loop has dispatched (the
+#: sum of its gateway_device_programs_total contributions — that
+#: family is process-global, so the per-replica split lives here).
+#: Refreshed at route/preempt time and on every fleet stats() pull.
+REPLICA_PROGRAMS = REGISTRY.gauge(
+    "gateway_replica_programs",
+    "Device programs dispatched by each fleet replica",
+)
+#: Each replica's prefix-registry hit rate (hits / lookups over
+#: committed admissions). Affinity routing drives this toward the
+#: panel's share rate on the chain-owning replica; random routing
+#: dilutes it fleet-wide. Refresh cadence as gateway_replica_programs.
+REPLICA_PREFIX_HIT_RATE = REGISTRY.gauge(
+    "gateway_replica_prefix_hit_rate",
+    "Per-replica prefix-registry hit rate (hits / lookups)",
+)
+#: Router-requested preemptions per replica: overload moments where
+#: resident chains were demoted to the shared host tier (freeing
+#: device pages) so the storm could be admitted instead of shed.
+REPLICA_PREEMPTIONS = REGISTRY.counter(
+    "gateway_replica_preemptions_total",
+    "Router-requested preempt-to-host-tier events per fleet replica",
+)
+#: Bytes resident in the FLEET-SCOPED host page store (one per
+#: ReplicaSet; any replica can restore any chain). The per-batcher
+#: gateway_kv_host_tier_bytes gauge tracks the same store when shared.
+REPLICA_SHARED_STORE_BYTES = REGISTRY.gauge(
+    "gateway_replica_shared_store_bytes",
+    "Bytes resident in the fleet-shared host page store",
 )
 
 

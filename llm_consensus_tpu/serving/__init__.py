@@ -10,6 +10,9 @@ panel fan-outs) enqueue requests; two granularities are offered:
 - :class:`ContinuousBatcher` — token-level continuous batching over a
   paged KV cache; requests join and leave the running decode batch at
   step granularity (the throughput-serving mode).
+- :class:`ReplicaSet` — N continuous batchers behind one prefix-
+  affinity router with a fleet-shared host page store (PR 14): the
+  scale-out layer (``serve --replicas K``).
 """
 
 from llm_consensus_tpu.serving.continuous import (
@@ -17,6 +20,12 @@ from llm_consensus_tpu.serving.continuous import (
     ContinuousBatcher,
     ContinuousConfig,
     ServeResult,
+)
+from llm_consensus_tpu.serving.fleet import (
+    FleetBackend,
+    FleetConfig,
+    PrefixRouter,
+    ReplicaSet,
 )
 from llm_consensus_tpu.serving.offload import HostPageStore
 from llm_consensus_tpu.serving.scheduler import (
@@ -30,7 +39,11 @@ __all__ = [
     "ContinuousBackend",
     "ContinuousBatcher",
     "ContinuousConfig",
+    "FleetBackend",
+    "FleetConfig",
     "HostPageStore",
+    "PrefixRouter",
+    "ReplicaSet",
     "SchedulerConfig",
     "ServeResult",
     "ServingBackend",

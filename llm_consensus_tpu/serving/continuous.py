@@ -265,6 +265,44 @@ _SCREEN_W = 8
 # client-supplied; see _screen_cache).
 _SCREEN_CACHE_MAX = 512
 
+# Cap on prefix_probe's host-tier extension walk (PR 14): each probed
+# page hashes a fresh chain-prefix tuple (O(chain) per lookup — the
+# store key is the full flat chain), so an unbounded walk is quadratic
+# in prompt length on the per-request routing hot path. Host tokens
+# only break ties between replicas' registry matches, and the signal
+# saturates after a few pages; past the cap the router still routes
+# correctly, it just stops counting deeper host residency.
+_PROBE_HOST_PAGES = 8
+
+
+def _weights_fingerprint(params) -> tuple:
+    """A cheap, deterministic identity for a parameter tree: leaf
+    count plus a hash over the first 4 elements of EVERY leaf (one
+    concatenated device fetch at construction — a single leaf would
+    not do: norm scales initialize to ones and embeddings can tie
+    across checkpoints, so the sample must span the tree). Two
+    batchers loaded from the same checkpoint (or sharing one tree,
+    shard_params included — resharding moves bytes, not values)
+    fingerprint equal; different weights differ with overwhelming
+    probability. The host-tier store scope includes this (PR 14): a
+    KV page's bytes are a function of the weights that wrote it, so
+    replicas serving different checkpoints of one config must never
+    cross-restore through a shared store."""
+    import hashlib
+
+    import jax.numpy as _jnp
+
+    leaves = jax.tree_util.tree_leaves(params)
+    sample = np.asarray(
+        _jnp.concatenate(
+            [
+                _jnp.ravel(leaf)[:4].astype(_jnp.float32)
+                for leaf in leaves
+            ]
+        )
+    ).tobytes()
+    return (len(leaves), hashlib.sha1(sample).hexdigest())
+
 
 @dataclass
 class ContinuousConfig:
@@ -611,6 +649,8 @@ class ContinuousBatcher:
         config: ContinuousConfig | None = None,
         mesh=None,
         draft: tuple[ModelConfig, dict] | None = None,
+        host_store: HostPageStore | None = None,
+        host_store_scope: tuple | None = None,
     ):
         self.cfg = cfg
         self.params = params
@@ -779,14 +819,76 @@ class ContinuousBatcher:
         # streaming of the slices is a chip-transport optimization the
         # correctness contract doesn't depend on.
         self._offload: HostPageStore | None = None
+        # Store-key scope (PR 14): with a FLEET-SHARED store, every key
+        # must carry the identity of the function that wrote the page —
+        # config dims, page size, pool dtype, the weights fingerprint,
+        # and the draft's equivalents (draft planes travel in the same
+        # entries) — so heterogeneous replicas can never cross-restore.
+        # A private (per-batcher) store pays the same prefix for free.
+        self._store_scope: tuple = ()
         if (
             c.host_cache_bytes > 0
             and c.share_prefix
             and c.prefill_chunk > 0
         ):
-            self._offload = HostPageStore(c.host_cache_bytes)
+            self._offload = (
+                host_store
+                if host_store is not None
+                else HostPageStore(c.host_cache_bytes)
+            )
+            if host_store_scope is not None:
+                # A sibling replica already computed the scope over the
+                # SAME cfg/params/store (ReplicaSet passes replica 0's
+                # down) — the weights fingerprint walks every param
+                # leaf, and K identical walks at fleet construction
+                # would be pure redundant startup latency.
+                self._store_scope = host_store_scope
+            elif host_store is None:
+                # PRIVATE store: nobody else can ever write or read
+                # it, so keys only need internal consistency — the
+                # empty scope keeps the pre-fleet behavior without
+                # paying the per-leaf fingerprint walk at every
+                # single-batcher `serve --host-cache-mb` start.
+                self._store_scope = ()
+            else:
+                scope = (
+                    cfg.name,
+                    cfg.n_layers,
+                    cfg.n_kv_heads,
+                    cfg.head_dim,
+                    c.page_size,
+                    str(self.cache.k.dtype),
+                    _weights_fingerprint(self.params),
+                )
+                if self._draft_cfg is not None:
+                    scope += (
+                        self._draft_cfg.name,
+                        self._draft_cfg.n_layers,
+                        self._draft_cfg.n_kv_heads,
+                        self._draft_cfg.head_dim,
+                        _weights_fingerprint(self._draft_params),
+                    )
+                self._store_scope = scope
             for reg in self._registries:
                 reg.on_evict = self._demote_nodes
+        elif host_store is not None:
+            raise ValueError(
+                "a shared host_store needs the offload tier engaged: "
+                "host_cache_bytes > 0, share_prefix, prefill_chunk > 0"
+            )
+        # Fleet hooks (PR 14): router-requested preemption (demote
+        # reclaimable registry chains to the host tier NOW, freeing
+        # pool pages for the overload storm instead of shedding 429s)
+        # and chain exports (spill a resident chain's ready pages to
+        # the shared store WITHOUT evicting, so another replica can
+        # restore it — the rebalance transport). Both are REQUESTS
+        # enqueued from router/gateway threads and executed by the
+        # worker loop: the demote path's device_get must never race
+        # the worker's dispatch-time buffer donation.
+        self._preempt_req = 0
+        self._preempted_pages = 0
+        self._exports: deque = deque()
+        self._exported_pages = 0
         # Pending page restores: (registry node, host planes). Filled at
         # admission, drained one page per loop iteration between decode
         # steps (the same bounded-stall discipline as prefill chunks);
@@ -1766,6 +1868,7 @@ class ContinuousBatcher:
         top_k: int | None = None,
         top_p: float | None = None,
         stop: list[str] | tuple[str, ...] | None = None,
+        prompt_ids=None,
     ) -> Future:
         """Enqueue a request; Future resolves to a :class:`ServeResult`.
 
@@ -1778,7 +1881,12 @@ class ContinuousBatcher:
         one). ``stop`` follows the engine's stop-sequence contract —
         text trimmed at the earliest stop (stop removed), and the row
         retires as soon as the stop appears (every token is
-        host-checked, so multi-token stops end decoding immediately)."""
+        host-checked, so multi-token stops end decoding immediately).
+        ``prompt_ids``: the prompt's already-encoded token ids — the
+        fleet router tokenizes once for routing and passes them
+        through (PR 14), so the common panel header is not encoded
+        twice per request. Must be THIS tokenizer's encoding of
+        ``prompt``; the same largest-bucket truncation applies."""
         if self._stop.is_set():
             raise RuntimeError("batcher stopped")
         c = self.config
@@ -1786,7 +1894,11 @@ class ContinuousBatcher:
             max_new_tokens = c.max_new_tokens
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens must be > 0, got {max_new_tokens}")
-        full_ids = self.tokenizer.encode(prompt)
+        full_ids = (
+            prompt_ids
+            if prompt_ids is not None
+            else self.tokenizer.encode(prompt)
+        )
         cap = c.seq_buckets[-1]
         if len(full_ids) > cap:
             if not c.truncate_prompts:
@@ -1856,6 +1968,200 @@ class ContinuousBatcher:
             ),
         }
 
+    # -- fleet surface (PR 14) ------------------------------------------
+    # Everything the replica router/gateway threads call on a batcher:
+    # read-only probes under the admission lock, plus preempt/export
+    # REQUESTS the worker loop executes (the demote path's device_get
+    # must never race the worker's dispatch-time buffer donation).
+
+    def prefix_probe(self, ids) -> dict:
+        """How much of this prompt's page-aligned prefix chain is
+        already resident here: ``registry_tokens`` (device pages — the
+        affinity signal; restore-free) and ``host_tokens`` (the host
+        tier's extension past the registry match — restorable at
+        device_put latency; capped at ``_PROBE_HOST_PAGES`` pages —
+        it only breaks ties). Read-only: no refcounts, ticks, or
+        counters move (PrefixRegistry.probe), so the router can probe
+        every replica per request. Unready (in-flight-prefill) nodes
+        count — a burst's mates must probe the donor's replica as a
+        match while its prefill is still running."""
+        c = self.config
+        pg = c.page_size
+        usable_full = (len(ids) - 1) // pg
+        if usable_full <= 0 or not c.share_prefix:
+            return {"registry_tokens": 0, "host_tokens": 0}
+        chain = tuple(int(t) for t in ids[: usable_full * pg])
+        best = (0, 0)
+        with self._lock:
+            for registry in self._registries:
+                _, t = registry.probe(ids)
+                k = t // pg
+                h = 0
+                if self._offload is not None:
+                    while (
+                        k + h < usable_full
+                        and h < _PROBE_HOST_PAGES
+                        and self._store_key(chain[: (k + h + 1) * pg])
+                        in self._offload
+                    ):
+                        h += 1
+                best = max(best, (t, h * pg))
+        return {"registry_tokens": best[0], "host_tokens": best[1]}
+
+    def load_cost(self) -> float:
+        """Modeled outstanding HBM bytes of this replica's admitted
+        work — the router's least-loaded signal (PR 14): the KV terms
+        of :meth:`_program_cost` integrated over each admitted
+        request's remaining schedule (remaining prefill writes, plus
+        every remaining decode step reading the whole committed
+        context and writing one token), per slot and per waiting
+        request. Weight reads amortize over whatever batch each
+        request joins and are identical across replicas, so they
+        cancel out of a load COMPARISON and are left out. A
+        32k-context request weighs what it costs, not one unit of
+        queue depth."""
+        kvb = self._kv_token_bytes + self._draft_kv_token_bytes
+        total = 0
+        with self._lock:
+            for s in self._slots:
+                if s is None:
+                    continue
+                done = len(s.generated)
+                rem = max(0, s.request.max_new_tokens - done)
+                L = s.prompt_len + done
+                if s.phase == "prefill":
+                    total += max(0, s.prompt_len - s.next_pos)
+                    rem = s.request.max_new_tokens
+                    L = s.prompt_len
+                total += rem * L + rem * (rem - 1) // 2 + rem
+            for r in self._waiting:
+                L, rem = len(r.prompt_ids), r.max_new_tokens
+                total += L + rem * L + rem * (rem - 1) // 2 + rem
+        return float(total * kvb)
+
+    def waiting_depth(self) -> int:
+        """Requests admitted to this batcher but not yet slotted — the
+        router's congestion signal for rebalancing (cheap; stats()
+        walks the registries and is too heavy per routed request)."""
+        with self._lock:
+            return len(self._waiting)
+
+    def device_programs_total(self) -> int:
+        """All device programs this batcher has dispatched (the
+        per-replica split of the process-global
+        gateway_device_programs_total)."""
+        with self._lock:
+            return sum(self._programs.values())
+
+    def prefix_hit_rate(self) -> float:
+        """Committed prefix-registry hit rate (hits / lookups; 0.0
+        before the first lookup)."""
+        with self._lock:
+            lookups = sum(r.lookups for r in self._registries)
+            hits = sum(r.hits for r in self._registries)
+        return hits / max(1, lookups)
+
+    def cached_chain_pages(self) -> int:
+        """ALL registry-resident chain pages, pinned-by-live-slots
+        included (cheap — a node count, no tree walk). The fleet
+        hook's is-there-anything-to-preserve signal: pinned chains
+        become demotable as their slots retire, so a non-zero count
+        means overload admission degrades to restore latency; zero
+        means the offered traffic registers nothing shareable and
+        classic shedding is the only backpressure left."""
+        with self._lock:
+            return sum(r.cached_pages for r in self._registries)
+
+    @property
+    def host_page_bytes(self) -> int:
+        """Approximate host-tier bytes one demoted page occupies
+        (target + draft planes; int8 pools' scale planes add a few
+        percent on top) — the router's store-headroom unit."""
+        return (
+            self._kv_token_bytes + self._draft_kv_token_bytes
+        ) * self.config.page_size
+
+    def request_preempt(self, n_pages: int) -> None:
+        """Ask the worker to demote up to ``n_pages`` reclaimable
+        registry pages to the host tier NOW — the fleet's
+        preempt-instead-of-shed lever: an overload storm frees device
+        pages at restore-latency cost instead of 429ing. Enqueued;
+        the worker's next iteration executes it (callable from any
+        thread). The backlog is the MAX of outstanding requests, not
+        the sum: a storm can call this hundreds of times between two
+        worker ticks, and summing would wipe the victim's entire
+        prefix cache in one giant evict walk + device_get under the
+        admission lock — one bounded demotion per worker iteration
+        while overflow persists is the intent."""
+        if self._offload is None or n_pages <= 0:
+            return
+        with self._lock:
+            self._preempt_req = max(self._preempt_req, int(n_pages))
+        self._work.set()
+
+    def request_export(self, ids) -> threading.Event:
+        """Ask the worker to spill the READY resident pages of this
+        prompt's registered prefix chain to the (shared) host store
+        WITHOUT evicting them — the rebalance transport: the chain
+        stays hot here and becomes restorable on any replica sharing
+        the store. Returns an Event set when the spill has run (set
+        immediately when the tier is off — nothing to do)."""
+        done = threading.Event()
+        if self._offload is None:
+            done.set()
+            return done
+        with self._lock:
+            self._exports.append((np.asarray(ids, np.int32), done))
+        self._work.set()
+        return done
+
+    def _preempt_step(self) -> None:
+        """Worker-side execution of queued preempt requests: one
+        registry evict walk whose on_evict hook demotes the victims
+        (the PR-4 path — preemption IS eviction pointed at the host
+        tier, requested by the router instead of by a short pool)."""
+        if not self._preempt_req:
+            return
+        with self._lock:
+            n, self._preempt_req = self._preempt_req, 0
+            freed = 0
+            for reg in self._registries:
+                if freed >= n:
+                    break
+                freed += reg.evict(n - freed)
+            self._preempted_pages += freed
+        if freed:
+            _flight.flight_recorder().record(
+                "preempt", time.perf_counter(), pages=freed
+            )
+
+    def _export_step(self) -> None:
+        """Worker-side execution of ONE queued chain export per loop
+        iteration (the same bounded-stall discipline as restores):
+        probe the registries for the chain's resident nodes, spill the
+        ready ones the store doesn't already hold."""
+        if not self._exports:
+            return
+        with self._lock:
+            if not self._exports:
+                return
+            ids, done = self._exports.popleft()
+            nodes: list = []
+            for reg in self._registries:
+                cand, _ = reg.probe(ids)
+                if len(cand) > len(nodes):
+                    nodes = cand
+            ready = [n for n in nodes if n.ready]
+            fetched = 0
+            if ready:
+                fetched, _ = self._spill_nodes(ready)
+            self._exported_pages += fetched
+        _flight.flight_recorder().record(
+            "export", time.perf_counter(), pages=fetched,
+            resident=len(ready),
+        )
+        done.set()
+
     def stats(self) -> dict:
         """Live serving counters — a consistent snapshot (the worker
         mutates slots/pages/counters under the same lock).
@@ -1915,6 +2221,12 @@ class ContinuousBatcher:
                 "offload_host_pages": (
                     len(self._offload) if self._offload else 0
                 ),
+                # Fleet hooks (PR 14): pages demoted by router-
+                # requested preemption (a subset of offload_demoted),
+                # and ready chain pages spilled by rebalance exports
+                # (resident here AND restorable fleet-wide).
+                "preempted_pages": self._preempted_pages,
+                "exported_pages": self._exported_pages,
                 # Span-derived step telemetry (PR 5): the same
                 # observations that feed gateway_decode_step_seconds /
                 # gateway_sched_overhead_seconds — one instrumentation
@@ -2016,6 +2328,11 @@ class ContinuousBatcher:
         self._work.set()
         self._thread.join(timeout=10)
         with self._lock:
+            # Pending rebalance exports never run now — release their
+            # waiters rather than leaving them to time out.
+            for _, ev in self._exports:
+                ev.set()
+            self._exports.clear()
             for req in self._waiting:
                 if not req.future.done():
                     req.future.set_exception(RuntimeError("batcher stopped"))
@@ -2201,7 +2518,9 @@ class ContinuousBatcher:
                                 int(t) for t in ids[: usable_full * pg]
                             )
                         while k < usable_full:
-                            planes = self._offload.get(chain[: (k + 1) * pg])
+                            planes = self._offload.get(
+                                self._store_key(chain[: (k + 1) * pg])
+                            )
                             if planes is None:
                                 break
                             restore_plan.append(planes)
@@ -2370,29 +2689,41 @@ class ContinuousBatcher:
         while self._inflight:
             self._fetch_one()
 
-    def _demote_nodes(self, nodes) -> None:
-        """PrefixRegistry.on_evict hook: spill an evict() walk's ready
-        victims to the host tier instead of losing them (worker thread,
-        inside the admission lock — the one place evictions happen).
+    def _store_key(self, chain: tuple) -> tuple:
+        """Host-tier key for a token chain: the batcher's store scope
+        (config/weights identity — see __init__) prepended, so a
+        fleet-shared store never cross-restores between heterogeneous
+        replicas. Private stores pay the same prefix harmlessly."""
+        return (self._store_scope, chain)
 
+    def _spill_nodes(self, nodes) -> tuple[int, int]:
+        """Spill the given registry nodes' pages to the host tier:
         ONE batched device_get covers every page the store doesn't
-        already hold — an eviction burst costs one host transfer, not
-        N sequential round trips stalling the decode loop. Chains that
+        already hold — a spill burst costs one host transfer, not N
+        sequential round trips stalling the decode loop. Chains that
         round-tripped before skip the fetch entirely (recency refresh
-        only). The Prometheus families move by the STORE's own deltas,
-        so a put() the budget refuses (oversize page) never counts as
-        a demotion on either surface.
+        only; a refresh that LOSES the race with a concurrent LRU drop
+        falls through to the fetch — the fleet-shared store's touch()
+        says which happened). Returns (pages fetched+put, refreshed).
+
+        The Prometheus families move by the STORE's own deltas, so a
+        put() the budget refuses (oversize page) never counts as a
+        demotion on either surface — and on a SHARED store the deltas
+        are this call's own (computed around our puts; concurrent
+        replicas' puts land in their own deltas).
+
+        Worker thread only (both callers — the evict hook and the
+        export step — run there): the device_get must not race a
+        dispatch-time buffer donation.
         """
         store = self._offload
-        demoted0 = store.demoted_pages
-        dropped0 = store.dropped_pages
         fetch: list[tuple[tuple, int]] = []
-        n_nodes = 0
+        refreshed = demoted = dropped = 0
         for node in nodes:
-            n_nodes += 1
-            key = PrefixRegistry.chain_tokens(node)
-            if key in store:
-                store.touch(key)
+            key = self._store_key(PrefixRegistry.chain_tokens(node))
+            if store.touch(key):
+                refreshed += 1
+                demoted += 1
             else:
                 fetch.append((key, node.page))
         if fetch:
@@ -2411,18 +2742,30 @@ class ContinuousBatcher:
             for i, (key, _) in enumerate(fetch):
                 # Contiguous copies: a view into the batch buffer would
                 # pin the whole [L, n, ...] fetch alive in the store.
-                store.put(
+                _, d, dr = store.put_counted(
                     key,
                     tuple(np.ascontiguousarray(pl[:, i]) for pl in got),
                 )
-        _M_OFF_DEMOTED.inc(store.demoted_pages - demoted0)
-        _M_OFF_DROPPED.inc(store.dropped_pages - dropped0)
+                demoted += d
+                dropped += dr
+        if demoted:
+            _M_OFF_DEMOTED.inc(demoted)
+        if dropped:
+            _M_OFF_DROPPED.inc(dropped)
         _M_OFF_HOST_BYTES.set(store.bytes_used)
+        return len(fetch), refreshed
+
+    def _demote_nodes(self, nodes) -> None:
+        """PrefixRegistry.on_evict hook: spill an evict() walk's ready
+        victims to the host tier instead of losing them (worker thread,
+        inside the admission lock — evictions happen at admission and
+        in the fleet's preempt step, both worker-side)."""
+        fetched, refreshed = self._spill_nodes(nodes)
         _flight.flight_recorder().record(
             "demote",
             time.perf_counter(),
-            pages=len(fetch),
-            refreshed=n_nodes - len(fetch),
+            pages=fetched,
+            refreshed=refreshed,
         )
 
     def _restore_step(self) -> bool:
@@ -3648,6 +3991,10 @@ class ContinuousBatcher:
     def _run(self) -> None:
         while not self._stop.is_set():
             self._hb_tick = time.monotonic()
+            # Fleet requests first (PR 14): preemption frees pages the
+            # admission below may need; exports are bounded spills.
+            self._preempt_step()
+            self._export_step()
             self._admit()
             progress = False
             ran_program = False

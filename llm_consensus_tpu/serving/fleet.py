@@ -1,0 +1,702 @@
+"""Prefix-affinity replica fleet: N batcher replicas behind one gateway.
+
+PR 13 finished scale-UP (every serving feature engages on dp×mp
+meshes); this module is the scale-OUT half (ROADMAP item 2): a
+:class:`ReplicaSet` owns K :class:`~llm_consensus_tpu.serving.
+continuous.ContinuousBatcher` replicas — in-process first, each with
+its own page pool, prefix registry, and jit caches (optionally its own
+mesh) over ONE shared parameter tree — and a :class:`PrefixRouter`
+places every request where its KV already lives:
+
+- **Prefix affinity.** The router fingerprints each request's
+  page-aligned prompt-prefix chain (the same
+  :func:`~llm_consensus_tpu.models.paged_cache.prefix_chain_key`
+  identity the registry and host tier key by) and probes every
+  replica's registry/host-tier READ-ONLY
+  (:meth:`ContinuousBatcher.prefix_probe`) for the longest resident
+  match. Consensus panels re-send the same huge header every
+  propose/evaluate/refine round, so "requests sharing a
+  radix-registry chain land where the pages already live" is the
+  COMMON case — the shared header prefills once FLEET-wide, not once
+  per replica. "Move the Query, Not the Cache" (PAPERS.md) is the
+  routing thesis: ship the request to the KV, never the KV to the
+  request.
+- **Least-modeled-cost fallback.** A request with no resident chain
+  anywhere goes to the replica with the least OUTSTANDING MODELED
+  WORK (:meth:`ContinuousBatcher.load_cost` — the PR-10 cost model's
+  KV terms integrated over every admitted request's remaining
+  schedule), not the shortest request queue: a 32k-context request is
+  not one unit of work.
+- **Preempt-to-host-tier instead of 429s.** The ReplicaSet creates ONE
+  fleet-scoped :class:`~llm_consensus_tpu.serving.offload.
+  HostPageStore` (thread-safe since PR 14; keys carry each replica's
+  config/weights scope) shared by every replica. Under overload the
+  gateway's admission controller consults
+  :meth:`ReplicaSet.preempt_for_admission` before shedding: while any
+  replica still holds demotable resident chains AND the shared tier
+  has headroom, the victim's lowest-priority chains demote to host
+  RAM (the PR-4 eviction path, router-requested) and the request is
+  ADMITTED past the queue bound — an overload storm degrades to
+  restore latency, not lost work. Shedding resumes when the host tier
+  is exhausted too, or when the offered traffic registers no chains
+  at all (nothing to ever preempt => keep classic backpressure).
+- **Rebalancing.** When the affinity owner is congested (its batcher
+  queue deeper than ``FleetConfig.rebalance_waiting``) and another
+  healthy replica is less loaded, the owner EXPORTS the chain's ready
+  pages through the shared store (:meth:`ContinuousBatcher.
+  request_export` — a spill, not an eviction: the chain stays hot at
+  the owner) and the request re-homes; the destination's admission
+  host-hits and restores the chain remotely.
+- **Per-replica readiness.** :meth:`ReplicaSet.heartbeat` aggregates
+  every replica's serving-loop heartbeat (one wedged replica flips the
+  gateway's ``/readyz`` and is reported by index), and the router
+  stops routing to stale/dead replicas while any healthy one remains.
+
+TPLA's disaggregated-inference framing motivates the role-aware
+replica abstraction: replicas are uniform here, but the router +
+shared-store transport is exactly the seam a prefill/decode role split
+(ROADMAP item 1, second half) plugs into.
+
+Threading: ``submit``/``route`` run on caller threads (the gateway
+event loop, tests); probes take each batcher's admission lock
+read-only; preempt/export are enqueued REQUESTS the batcher worker
+executes (device transfers must not race dispatch-time buffer
+donation). The fleet itself keeps only trivially-locked counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+from llm_consensus_tpu.backends import base as _backend_base
+from llm_consensus_tpu.engine.tokenizer import ByteTokenizer, Tokenizer
+from llm_consensus_tpu.models.configs import ModelConfig
+from llm_consensus_tpu.models.paged_cache import prefix_chain_key
+from llm_consensus_tpu.server.metrics import (
+    REPLICA_PREEMPTIONS as _M_PREEMPTIONS,
+)
+from llm_consensus_tpu.server.metrics import (
+    REPLICA_PREFIX_HIT_RATE as _M_HIT_RATE,
+)
+from llm_consensus_tpu.server.metrics import (
+    REPLICA_PROGRAMS as _M_PROGRAMS,
+)
+from llm_consensus_tpu.server.metrics import (
+    REPLICA_ROUTED as _M_ROUTED,
+)
+from llm_consensus_tpu.server.metrics import (
+    REPLICA_SHARED_STORE_BYTES as _M_STORE_BYTES,
+)
+from llm_consensus_tpu.serving import flight as _flight
+from llm_consensus_tpu.serving.continuous import (
+    ContinuousBatcher,
+    ContinuousConfig,
+)
+from llm_consensus_tpu.serving.offload import HostPageStore
+
+log = logging.getLogger(__name__)
+
+__all__ = ["FleetConfig", "PrefixRouter", "ReplicaSet", "FleetBackend"]
+
+#: Routing reasons (the ``reason`` label of
+#: ``gateway_replica_routed_total`` and the stats() mirror keys).
+ROUTE_REASONS = ("prefix", "load", "rebalance", "random")
+
+
+@dataclass
+class FleetConfig:
+    #: Batcher replicas behind the one gateway (``serve --replicas``).
+    replicas: int = 2
+    #: ``"prefix"`` — affinity routing (the subsystem's point).
+    #: ``"random"`` — round-robin, the bench leg's control: it
+    #: deliberately ignores resident chains so the A/B isolates what
+    #: affinity buys.
+    policy: str = "prefix"
+    #: Minimum RESIDENT full pages for an affinity claim: below it the
+    #: match is noise (every prompt shares a BOS-ish page with
+    #: something) and least-loaded placement wins.
+    affinity_min_pages: int = 1
+    #: The router stops routing to a replica whose serving-loop
+    #: heartbeat is staler than this (wedged device call / dead loop)
+    #: while any healthy replica remains — the same threshold shape as
+    #: the gateway's ``/readyz`` probe.
+    ready_stall_s: float = 10.0
+    #: Rebalance trigger: when the affinity owner's batcher queue is
+    #: deeper than this many requests and a less-loaded healthy
+    #: replica exists, export the chain through the shared store and
+    #: re-home the request. None = 4 × the batcher's ``max_slots`` —
+    #: deep enough that a plain panel burst never scatters its mates.
+    rebalance_waiting: int | None = None
+    #: Pages demoted per router-requested preemption (one overflow
+    #: moment frees about one admission's worth of pool pages).
+    preempt_pages: int = 8
+    #: How long an auto-rebalance waits for the owner's chain export
+    #: to land in the shared store before re-homing the request. The
+    #: export runs on the owner's worker at its next loop iteration
+    #: (ms-scale even mid-burst); without the wait the destination's
+    #: admission usually probes the store BEFORE the spill and
+    #: re-prefills the whole chain. Applied ONLY off the asyncio
+    #: event loop (the gateway path never blocks — its first re-homed
+    #: mate goes cache-cold and the hinted mates behind it restore
+    #: once the spill lands); bounded, and rebalances only fire at
+    #: congestion moments. 0 = always fire-and-forget.
+    rebalance_export_wait_s: float = 0.5
+
+
+class PrefixRouter:
+    """Routing policy over a ReplicaSet's batchers. Stateless apart
+    from a round-robin cursor; every decision re-probes live replica
+    state, so evictions, restores, and retirements re-route the next
+    request correctly with no cache-invalidation protocol."""
+
+    #: Bound on the pending-route hint table (entries are tiny; the
+    #: registry itself takes over once admissions land).
+    RECENT_MAX = 1024
+    #: Seconds a pending-route hint stays authoritative. It only needs
+    #: to cover the submit→admission window of a burst; after that the
+    #: owner's REGISTRY holds the chain and the live probe wins.
+    RECENT_TTL_S = 30.0
+
+    def __init__(
+        self,
+        batchers: list[ContinuousBatcher],
+        config: FleetConfig,
+        page_size: int,
+    ):
+        self.batchers = batchers
+        self.config = config
+        self.page_size = page_size
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        # Pending-route hints: first prefix-page run -> (replica,
+        # deadline). A burst's mates route BEFORE the first request is
+        # even admitted (registration happens at admission), so the
+        # live registry probe alone would scatter the panel across
+        # replicas; the hint pins the chain's home for the
+        # submit→admission window. First-page granularity — the same
+        # bucket key GroupTracker's stream planning uses.
+        self._recent: dict[tuple, tuple[int, float]] = {}
+
+    def healthy(self) -> list[int]:
+        """Replicas whose serving loop is alive and fresh. Falls back
+        to ALL replicas when none qualify — routing somewhere beats
+        failing everywhere, and the gateway's /readyz is already
+        reporting the outage."""
+        out = []
+        for i, b in enumerate(self.batchers):
+            hb = b.heartbeat()
+            if hb["alive"] and hb["last_tick_age_s"] <= self.config.ready_stall_s:
+                out.append(i)
+        return out or list(range(len(self.batchers)))
+
+    def _next_rr(self, candidates: list[int]) -> int:
+        with self._rr_lock:
+            idx = candidates[self._rr % len(candidates)]
+            self._rr += 1
+        return idx
+
+    def _hint_get(self, chain) -> int | None:
+        """Pending-route hint for this chain's first page run, if the
+        hinted replica is still plausible (fresh entry, in-range)."""
+        if not chain:
+            return None
+        with self._rr_lock:
+            hit = self._recent.get(chain[0])
+            if hit is None:
+                return None
+            idx, deadline = hit
+            if time.monotonic() > deadline:
+                del self._recent[chain[0]]
+                return None
+        return idx
+
+    def _hint_put(self, chain, idx: int) -> None:
+        if not chain:
+            return
+        with self._rr_lock:
+            while len(self._recent) >= self.RECENT_MAX:
+                self._recent.pop(next(iter(self._recent)))
+            self._recent[chain[0]] = (
+                idx,
+                time.monotonic() + self.RECENT_TTL_S,
+            )
+
+    @staticmethod
+    def _off_loop() -> bool:
+        """True when NOT running on an asyncio event loop — the only
+        place a blocking wait is acceptable."""
+        import asyncio
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return True
+        return False
+
+    def route(self, ids, chain=None) -> tuple[int, str]:
+        """Pick a replica for a request with prompt token ids ``ids``.
+        Returns ``(replica index, reason)`` — reason is one of
+        :data:`ROUTE_REASONS`. ``chain``: the ids' precomputed
+        :func:`prefix_chain_key` (the submit path fingerprints ONCE
+        and threads it through; None recomputes)."""
+        c = self.config
+        healthy = self.healthy()
+        if c.policy == "random":
+            # The control policy stays deliberately chain-blind (no
+            # hints either) — the A/B isolates what affinity buys.
+            return self._next_rr(healthy), "random"
+        if chain is None:
+            chain = prefix_chain_key(ids, self.page_size)
+        # Longest resident chain wins (registry pages first — they are
+        # restore-free; host-tier tokens break registry ties).
+        best_score = (0, 0)
+        owner = None
+        for i in healthy:
+            p = self.batchers[i].prefix_probe(ids)
+            score = (p["registry_tokens"], p["host_tokens"])
+            if score > best_score:
+                best_score, owner = score, i
+        floor = c.affinity_min_pages * self.page_size
+        if best_score[0] < floor and len(chain) >= c.affinity_min_pages:
+            # No device-RESIDENT chain clears the floor (a host-tier
+            # hit ties across replicas — the store is fleet-shared),
+            # but a burst-mate may have been routed milliseconds ago
+            # and not admitted yet — the pending-route hint is the
+            # affinity signal for that window, and it also keeps a
+            # post-preempt burst together so the chain restores ONCE
+            # instead of once per scattered mate.
+            hinted = self._hint_get(chain)
+            if hinted is not None and hinted in healthy:
+                owner = hinted
+                best_score = (floor, 0)
+        if owner is not None and best_score[0] >= floor:
+            limit = c.rebalance_waiting
+            if limit is None:
+                limit = 4 * self.batchers[owner].config.max_slots
+            if self.batchers[owner].waiting_depth() > limit:
+                # The chain's owner is congested: move the chain, not
+                # the cache-miss — export its ready pages through the
+                # shared store (spill, not eviction) and re-home the
+                # request to a healthy alternative, whose admission
+                # will restore the chain remotely. If a mate already
+                # moved this chain (the hint names a non-owner), FOLLOW
+                # IT: burst mates must coalesce on one destination —
+                # re-running min-load per mate scatters the chain onto
+                # several replicas and re-exports it once per mate.
+                others = [i for i in healthy if i != owner]
+                if others:
+                    hinted = self._hint_get(chain)
+                    if hinted is not None and hinted in others:
+                        return hinted, "rebalance"
+                    dst = min(
+                        others, key=lambda i: self.batchers[i].load_cost()
+                    )
+                    ev = self.batchers[owner].request_export(ids)
+                    if c.rebalance_export_wait_s > 0 and self._off_loop():
+                        # Let the spill land before the destination's
+                        # admission probes the store — otherwise the
+                        # re-homed request re-prefills the chain the
+                        # export was about to make restorable.
+                        # Bounded, and NEVER on an asyncio event loop
+                        # (a synchronous wait there would freeze the
+                        # whole gateway under exactly the load spike
+                        # rebalancing exists to absorb) — the async
+                        # path goes cache-cold for this first mate and
+                        # the hinted mates behind it restore once the
+                        # spill lands.
+                        ev.wait(c.rebalance_export_wait_s)
+                    _flight.flight_recorder().record(
+                        "rebalance",
+                        time.perf_counter(),
+                        src=owner,
+                        dst=dst,
+                        chain_pages=best_score[0] // self.page_size,
+                    )
+                    # The chain is moving: follow-up mates land at the
+                    # destination too (the hint check above).
+                    self._hint_put(chain, dst)
+                    return dst, "rebalance"
+            self._hint_put(chain, owner)
+            return owner, "prefix"
+        # No affinity anywhere: least outstanding MODELED work (the
+        # PR-10 cost model integrated over admitted requests), ties by
+        # index for determinism. The hint makes this request's replica
+        # the chain's home for burst-mates behind it.
+        dst = min(healthy, key=lambda i: (self.batchers[i].load_cost(), i))
+        self._hint_put(chain, dst)
+        return dst, "load"
+
+
+class ReplicaSet:
+    """K continuous-batcher replicas + the router + the shared store.
+
+    Construction mirrors :class:`ContinuousBatcher`: one model config
+    and parameter tree (shared by every replica — jax arrays are
+    immutable; a per-replica mesh re-shards without copying the
+    original), one :class:`ContinuousConfig` INSTANCE all replicas
+    read live (the bench's knob-flip lever works fleet-wide), and an
+    optional draft model passed through to every replica. With
+    ``config.host_cache_bytes > 0`` the fleet creates ONE
+    :class:`HostPageStore` with that (fleet-wide) budget and hands it
+    to every replica — the preempt/rebalance transport.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        tokenizer: Tokenizer | None = None,
+        config: ContinuousConfig | None = None,
+        fleet: FleetConfig | None = None,
+        mesh=None,
+        meshes: list | None = None,
+        draft: tuple[ModelConfig, dict] | None = None,
+    ):
+        self.cfg = cfg
+        self.config = config or ContinuousConfig()
+        self.fleet_config = fleet or FleetConfig()
+        if self.fleet_config.replicas < 1:
+            raise ValueError(
+                f"need >= 1 replica, got {self.fleet_config.replicas}"
+            )
+        if self.fleet_config.policy not in ("prefix", "random"):
+            raise ValueError(
+                f"unknown routing policy {self.fleet_config.policy!r}"
+            )
+        self.tokenizer = tokenizer or ByteTokenizer()
+        k = self.fleet_config.replicas
+        if meshes is not None and len(meshes) != k:
+            raise ValueError(
+                f"meshes has {len(meshes)} entries for {k} replicas"
+            )
+        replica_meshes = meshes if meshes is not None else [mesh] * k
+        c = self.config
+        self.store: HostPageStore | None = None
+        if c.host_cache_bytes > 0 and c.share_prefix and c.prefill_chunk > 0:
+            # ONE store, fleet-wide budget: any replica restores any
+            # chain (store keys carry each replica's config/weights
+            # scope, so a heterogeneous fleet can never cross-restore).
+            self.store = HostPageStore(c.host_cache_bytes)
+        self.batchers: list[ContinuousBatcher] = []
+        scope: tuple | None = None
+        for i in range(k):
+            b = ContinuousBatcher(
+                cfg,
+                params,
+                tokenizer=self.tokenizer,
+                config=c,
+                mesh=replica_meshes[i],
+                draft=draft,
+                host_store=self.store,
+                # Replica 0 computes the store-key scope (a walk over
+                # every param leaf); its siblings share the identical
+                # cfg/params, so they reuse it instead of re-walking.
+                host_store_scope=scope,
+            )
+            if self.store is not None and scope is None:
+                scope = b._store_scope
+            self.batchers.append(b)
+        self.router = PrefixRouter(
+            self.batchers, self.fleet_config, c.page_size
+        )
+        # stats() mirrors of the routed/preempt Prometheus counters
+        # (lockstep tested).
+        self._lock = threading.Lock()
+        self._routed = [
+            {r: 0 for r in ROUTE_REASONS} for _ in range(k)
+        ]
+        self._preempt_requests = [0] * k
+
+    # -- serving --------------------------------------------------------
+
+    def _route_ids(self, prompt: str):
+        """The prompt's token ids AS THE BATCHER WILL SEE THEM (the
+        same largest-bucket left-truncation submit applies) — routing
+        on the untruncated prompt could affine on a prefix the
+        admission then cuts off."""
+        ids = self.tokenizer.encode(prompt)
+        return ids[-self.config.seq_buckets[-1] :]
+
+    def submit(self, prompt: str, **kw):
+        """Route + submit; returns the replica batcher's Future.
+        Keyword args pass through to
+        :meth:`ContinuousBatcher.submit`. The prompt is tokenized
+        ONCE — the FULL encoding is handed to the batcher (so its own
+        over-long-prompt policy still applies: reject under
+        ``truncate_prompts=False``, warn+left-truncate otherwise)
+        while routing sees the truncated view the admission will
+        actually serve."""
+        full_ids = self.tokenizer.encode(prompt)
+        ids = full_ids[-self.config.seq_buckets[-1] :]
+        chain = prefix_chain_key(ids, self.config.page_size)
+        idx, reason = self.router.route(ids, chain=chain)
+        self._count_route(idx, reason, chain)
+        return self.batchers[idx].submit(
+            prompt, prompt_ids=full_ids, **kw
+        )
+
+    def submit_to(self, idx: int, prompt: str, **kw):
+        """Bypass the router (tests, pinned traffic)."""
+        return self.batchers[idx].submit(prompt, **kw)
+
+    def _count_route(self, idx: int, reason: str, chain) -> None:
+        _M_ROUTED.labels(replica=str(idx), reason=reason).inc()
+        with self._lock:
+            self._routed[idx][reason] += 1
+        b = self.batchers[idx]
+        _M_PROGRAMS.labels(replica=str(idx)).set(b.device_programs_total())
+        _M_HIT_RATE.labels(replica=str(idx)).set(b.prefix_hit_rate())
+        if self.store is not None:
+            _M_STORE_BYTES.set(self.store.bytes_used)
+        _flight.flight_recorder().record(
+            "route",
+            time.perf_counter(),
+            replica=idx,
+            reason=reason,
+            chain_pages=len(chain),
+        )
+
+    # -- overload: preempt instead of shed ------------------------------
+
+    def preempt_for_admission(self) -> bool:
+        """The gateway admission controller's overflow hook: called at
+        a queue-full moment, returns True to ADMIT past the bound
+        instead of shedding 429.
+
+        Preemption is possible while (a) the shared tier can absorb
+        another page (a full tier would evict other requests'
+        preserved work — real loss) AND (b) the fleet shows ANY
+        preserved or preservable chain work: registry-resident chains
+        (pinned-by-live-slots included — a transient all-pinned
+        moment still admits; chains demote as slots retire) OR
+        entries already in the shared store. The store clause matters
+        right after a preemption: the demoted chains have LEFT the
+        registries and the storm's own chains have not registered
+        yet, but the preserved work is sitting in the tier — shedding
+        in that window would 429 the exact storm preemption exists to
+        absorb. Traffic that registers NOTHING shareable ever
+        (sub-page prompts, a sharing-off fleet) populates neither
+        surface and keeps the classic 429 backpressure — admitting it
+        past the bound would grow the queue without bound with
+        nothing to preempt. When some replica holds demotable chains
+        right now, the one with the most (the victim) is asked to
+        demote ``FleetConfig.preempt_pages`` of its lowest-priority
+        chains, freeing device pool pages for the storm. Cheap on the
+        happy path (node-count reads — no registry tree walks on the
+        event loop; the demotion itself runs on the victim's worker
+        thread), but it MAY briefly synchronize with an in-flight
+        spill's device_get through the victim's lock — that
+        synchronization is deliberate, see ORDER MATTERS below."""
+        store = self.store
+        if store is None:
+            return False
+        page_bytes = max(b.host_page_bytes for b in self.batchers)
+        if store.headroom_bytes < page_bytes:
+            return False
+        # Victim selection by CACHED node counts (O(1) per replica),
+        # not by the reclaimable-pages tree walk — this runs on the
+        # gateway event loop once per overflowing submit. A victim
+        # whose chains are all pinned right now makes the preempt
+        # request a worker-side no-op; the pages demote as slots
+        # retire either way.
+        victim, pages = None, 0
+        for i, b in enumerate(self.batchers):
+            r = b.cached_chain_pages()
+            if r > pages:
+                victim, pages = i, r
+        # ORDER MATTERS: the registry probe above synchronizes on each
+        # batcher's lock, so while a preempt's evict+demote is
+        # mid-flight this call blocks until the victim's store puts
+        # have landed, and the store read BELOW sees them. Reading the
+        # store first can pair a pre-demote store (empty) with a
+        # post-demote registry (empty) and shed spuriously in the one
+        # window preemption exists to cover (observed: 1/12 storm
+        # requests 429'd under the reversed order).
+        if victim is None and len(store) == 0:
+            return False
+        if victim is not None:
+            self.batchers[victim].request_preempt(
+                min(pages, self.fleet_config.preempt_pages)
+            )
+            _M_PREEMPTIONS.labels(replica=str(victim)).inc()
+            with self._lock:
+                self._preempt_requests[victim] += 1
+        return True
+
+    # -- rebalance (explicit) -------------------------------------------
+
+    def rebalance_chain(
+        self, prompt: str, wait_s: float | None = 30.0
+    ) -> int | None:
+        """Export ``prompt``'s resident chain from its owning replica
+        into the shared store (spill, not eviction), so ANY replica's
+        next same-prefix admission restores it remotely. Returns the
+        owner's index (None when no replica holds the chain). The
+        router does this automatically under owner congestion; this is
+        the explicit lever (tests, operational drain)."""
+        ids = self._route_ids(prompt)
+        owner, best = None, 0
+        for i, b in enumerate(self.batchers):
+            t = b.prefix_probe(ids)["registry_tokens"]
+            if t > best:
+                owner, best = i, t
+        if owner is None:
+            return None
+        ev = self.batchers[owner].request_export(ids)
+        if wait_s is not None and not ev.wait(wait_s):
+            raise TimeoutError(
+                f"replica {owner} did not run the chain export "
+                f"within {wait_s}s"
+            )
+        return owner
+
+    # -- observability / lifecycle --------------------------------------
+
+    def heartbeat(self) -> dict:
+        """Aggregate serving-loop liveness: ``alive`` only when EVERY
+        replica's loop is alive (a degraded fleet must flip /readyz —
+        one wedged replica is a capacity loss the balancer upstream
+        should see), ``last_tick_age_s`` is the STALEST replica's, and
+        ``replicas`` carries each loop's own heartbeat so the gateway
+        can name the wedged index."""
+        hbs = [b.heartbeat() for b in self.batchers]
+        return {
+            "alive": all(h["alive"] for h in hbs),
+            "last_tick_age_s": max(h["last_tick_age_s"] for h in hbs),
+            "last_step_age_s": max(
+                (
+                    h["last_step_age_s"]
+                    for h in hbs
+                    if h["last_step_age_s"] is not None
+                ),
+                default=None,
+            ),
+            "replicas": hbs,
+        }
+
+    def stats(self) -> dict:
+        """Fleet snapshot: per-replica batcher stats plus aggregates.
+        Shared-store counters are taken from the STORE once — each
+        replica's own ``offload_demoted/dropped/host_bytes`` keys read
+        the same shared store, so summing them would multiply-count.
+        Pulling stats also refreshes the per-replica gauges
+        (``gateway_replica_programs`` / ``_prefix_hit_rate`` /
+        ``_shared_store_bytes``), so a scrape following a stats pull
+        is current."""
+        per = [b.stats() for b in self.batchers]
+        for i, b in enumerate(self.batchers):
+            # The same accessors the route-time refresh uses — ONE
+            # definition of each gauge's value (a second copy keyed on
+            # the program-kind list would drift the moment a kind is
+            # added).
+            _M_PROGRAMS.labels(replica=str(i)).set(
+                b.device_programs_total()
+            )
+            _M_HIT_RATE.labels(replica=str(i)).set(b.prefix_hit_rate())
+        if self.store is not None:
+            _M_STORE_BYTES.set(self.store.bytes_used)
+        with self._lock:
+            routed = [dict(r) for r in self._routed]
+            preempts = list(self._preempt_requests)
+        agg_lookups = sum(s["prefix_lookups"] for s in per)
+        return {
+            "replicas": len(self.batchers),
+            "policy": self.fleet_config.policy,
+            "per_replica": per,
+            "routed": routed,
+            "routed_total": sum(sum(r.values()) for r in routed),
+            "routed_prefix": sum(r["prefix"] for r in routed),
+            "preempt_requests": preempts,
+            "completed_requests": sum(
+                s["completed_requests"] for s in per
+            ),
+            "generated_tokens": sum(s["generated_tokens"] for s in per),
+            "prefill_chunks": sum(s["prefill_chunks"] for s in per),
+            "prefix_lookups": agg_lookups,
+            "prefix_hits": sum(s["prefix_hits"] for s in per),
+            "prefix_hit_rate": (
+                sum(s["prefix_hits"] for s in per) / max(1, agg_lookups)
+            ),
+            "prefix_pages_shared": sum(
+                s["prefix_pages_shared"] for s in per
+            ),
+            "preempted_pages": sum(s["preempted_pages"] for s in per),
+            "exported_pages": sum(s["exported_pages"] for s in per),
+            "offload_restored_pages": sum(
+                s["offload_restored_pages"] for s in per
+            ),
+            "offload_demoted_pages": (
+                self.store.demoted_pages if self.store else 0
+            ),
+            "offload_dropped_pages": (
+                self.store.dropped_pages if self.store else 0
+            ),
+            "shared_store_bytes": (
+                self.store.bytes_used if self.store else 0
+            ),
+            "shared_store_pages": len(self.store) if self.store else 0,
+        }
+
+    def close(self) -> None:
+        for b in self.batchers:
+            b.close()
+
+
+class FleetBackend(_backend_base.Backend):
+    """Backend seam over a :class:`ReplicaSet` — the fleet counterpart
+    of :class:`~llm_consensus_tpu.serving.continuous.
+    ContinuousBackend`. The Coordinator's panel fan-out submits each
+    member through the router, so panel mates affine to the replica
+    whose registry holds their shared header; ``health()`` exposes the
+    aggregate heartbeat (per-replica entries included) for the
+    gateway's /readyz, and ``preempt_for_admission`` is the overflow
+    hook the gateway wires into its admission controller."""
+
+    def __init__(self, replicas: ReplicaSet):
+        self.replicas = replicas
+
+    async def generate_batch(self, requests):
+        import asyncio
+
+        BackendError = _backend_base.BackendError
+        GenerationResult = _backend_base.GenerationResult
+
+        futs = []
+        try:
+            for r in requests:
+                futs.append(
+                    self.replicas.submit(
+                        r.prompt,
+                        max_new_tokens=r.params.max_new_tokens,
+                        temperature=r.params.temperature,
+                        seed=r.params.seed,
+                        top_k=r.params.top_k,
+                        top_p=r.params.top_p,
+                        stop=r.params.stop,
+                    )
+                )
+        except (RuntimeError, ValueError) as e:
+            # Mirror ContinuousBackend: a mid-batch submit failure must
+            # not orphan earlier members' device work silently.
+            for f in futs:
+                f.cancel()
+            raise BackendError(f"fleet submit failed: {e}") from e
+        outs = await asyncio.gather(*(asyncio.wrap_future(f) for f in futs))
+        return [
+            GenerationResult(
+                text=o.text, num_tokens=o.num_tokens, meta=o.timing
+            )
+            for o in outs
+        ]
+
+    def health(self) -> dict:
+        return self.replicas.heartbeat()
+
+    def preempt_for_admission(self) -> bool:
+        return self.replicas.preempt_for_admission()
+
+    async def close(self) -> None:
+        self.replicas.close()

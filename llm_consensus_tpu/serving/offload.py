@@ -32,8 +32,27 @@ least-recently-used page cleanly (the tier below host RAM is
 recompute, which is always correct).
 
 Host-side only and jax-free on the hot paths (plain numpy + an
-OrderedDict); the batcher owns the device transfers. Not thread-safe —
-the continuous batcher's worker owns it, like the pools/registries.
+OrderedDict); the batcher owns the device transfers.
+
+**Fleet-scoped since PR 14** (:mod:`llm_consensus_tpu.serving.fleet`):
+one store can back N batcher replicas, so any replica can restore a
+chain any other replica demoted — the page transport behind both
+preempt-to-host-tier and chain rebalancing. Two consequences:
+
+- The store is now THREAD-SAFE: every method holds one internal lock,
+  and the check-then-act demote race ("is the chain resident? then
+  refresh, else fetch") is closed by :meth:`touch` returning whether
+  the key was still resident — a concurrent LRU drop between a
+  caller's probe and its ``touch`` degrades to a fresh ``put``, never
+  a silent recency update of a ghost entry.
+- Callers that share a store MUST namespace their keys by model/config
+  identity (the batcher prepends its
+  :attr:`~llm_consensus_tpu.serving.continuous.ContinuousBatcher`
+  store scope — config dims, page size, pool dtype, and a weights
+  fingerprint): a page's bytes are a function of the weights that
+  wrote it, so heterogeneous replicas must never cross-restore. The
+  store itself stays key-agnostic (tests use bare chains on private
+  stores).
 
 Mesh-native since PR 13: on a dp×mp mesh the demote ``device_get``
 assembles a page's sharded plane slices into one host buffer and the
@@ -47,6 +66,7 @@ on.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Sequence
 
@@ -68,14 +88,19 @@ class HostPageStore:
     recompute). ``get`` returns the planes verbatim and refreshes
     recency; entries SURVIVE a restore, so a prefix that round-trips
     HBM → host → HBM → evicted again re-demotes without a second
-    device fetch (:meth:`contains` lets the demote hook skip the
+    device fetch (:meth:`touch` lets the demote hook skip the
     ``device_get``).
+
+    Thread-safe (PR 14): one lock serializes every mutation, so N
+    fleet replicas can demote/restore concurrently — counters, the
+    LRU order, and the byte accounting stay exact under interleaving.
     """
 
     def __init__(self, budget_bytes: int):
         if budget_bytes <= 0:
             raise ValueError(f"budget_bytes must be > 0, got {budget_bytes}")
         self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, Planes]" = OrderedDict()
         self._bytes = 0
         # Monotonic counters (the serving layer exports these).
@@ -85,14 +110,24 @@ class HostPageStore:
         self.hits = 0
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def __contains__(self, key: tuple) -> bool:
-        return key in self._entries
+        with self._lock:
+            return key in self._entries
 
     @property
     def bytes_used(self) -> int:
         return self._bytes
+
+    @property
+    def headroom_bytes(self) -> int:
+        """Budget not yet occupied — the fleet router's "can the tier
+        absorb a preempted page without dropping someone else's work"
+        signal (:meth:`ReplicaSet.preempt_for_admission`)."""
+        with self._lock:
+            return max(0, self.budget_bytes - self._bytes)
 
     @staticmethod
     def _nbytes(planes: Planes) -> int:
@@ -102,43 +137,70 @@ class HostPageStore:
         """Demote one page's planes. Returns True when resident after
         the call (a page bigger than the whole budget is refused — it
         could only live by evicting everything for one entry)."""
+        resident, _, _ = self.put_counted(key, planes)
+        return resident
+
+    def put_counted(
+        self, key: tuple, planes: Sequence[np.ndarray]
+    ) -> tuple[bool, int, int]:
+        """:meth:`put` returning ``(resident, demoted, dropped)`` —
+        THIS call's own counter deltas, computed under the lock. On a
+        fleet-shared store a caller must not reconstruct its deltas
+        from the global counters around a call: a concurrent replica's
+        puts interleave and would be double-counted into both
+        replicas' Prometheus increments."""
         planes = tuple(np.asarray(p) for p in planes)
-        if key in self._entries:
-            # Same chain => same content (KV is a deterministic function
-            # of the chain); refresh recency, keep the original bytes.
+        nbytes = self._nbytes(planes)
+        with self._lock:
+            if key in self._entries:
+                # Same chain => same content (KV is a deterministic
+                # function of the chain — scoped keys pin the weights
+                # too); refresh recency, keep the original bytes. Two
+                # replicas racing the same demote land here: the second
+                # put degrades to a refresh, never double-accounting
+                # bytes.
+                self._entries.move_to_end(key)
+                self.demoted_pages += 1
+                return True, 1, 0
+            if nbytes > self.budget_bytes:
+                self.dropped_pages += 1
+                return False, 0, 1
+            self._entries[key] = planes
+            self._bytes += nbytes
+            self.demoted_pages += 1
+            dropped = 0
+            while self._bytes > self.budget_bytes:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= self._nbytes(victim)
+                self.dropped_pages += 1
+                dropped += 1
+            return True, 1, dropped
+
+    def touch(self, key: tuple) -> bool:
+        """Re-demotion of a chain already resident: same chain => same
+        content, so only recency moves — no second device fetch, no
+        byte-accounting change. Returns False when the key is GONE (a
+        concurrent LRU drop won the race between the caller's probe
+        and this call) — the caller must then fetch + :meth:`put` like
+        a fresh demotion instead of assuming residency."""
+        with self._lock:
+            if key not in self._entries:
+                return False
             self._entries.move_to_end(key)
             self.demoted_pages += 1
             return True
-        nbytes = self._nbytes(planes)
-        if nbytes > self.budget_bytes:
-            self.dropped_pages += 1
-            return False
-        self._entries[key] = planes
-        self._bytes += nbytes
-        self.demoted_pages += 1
-        while self._bytes > self.budget_bytes:
-            _, victim = self._entries.popitem(last=False)
-            self._bytes -= self._nbytes(victim)
-            self.dropped_pages += 1
-        return True
-
-    def touch(self, key: tuple) -> None:
-        """Re-demotion of a chain already resident: same chain => same
-        content, so only recency moves — no second device fetch, no
-        byte-accounting change (the demote hook checks ``in`` first)."""
-        self._entries.move_to_end(key)
-        self.demoted_pages += 1
 
     def get(self, key: tuple) -> Planes | None:
         """Planes for ``key`` (verbatim), refreshing recency; None on
         miss. The entry stays resident — restore does not consume it."""
-        self.lookups += 1
-        planes = self._entries.get(key)
-        if planes is None:
-            return None
-        self._entries.move_to_end(key)
-        self.hits += 1
-        return planes
+        with self._lock:
+            self.lookups += 1
+            planes = self._entries.get(key)
+            if planes is None:
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return planes
 
 
 def page_planes(cache, page: int) -> tuple[np.ndarray, np.ndarray]:
