@@ -537,6 +537,28 @@ def main() -> int:
         "--serve-decode-rounds",
     )
     p.add_argument(
+        "--serve-adaptive",
+        action="store_true",
+        help="roofline-adaptive runtime control A/B leg (PR 15): ONE "
+        "batcher carrying an adversarial random-weight draft serves "
+        "the same mixed greedy burst under every fixed (spec_k x R) "
+        "knob grid point — spec on at k in {1, K}, spec off at R in "
+        "{1, R} — and under the adaptive controller steering "
+        "spec_k/rounds/chunk/depth live from measured acceptance, "
+        "modeled MBU, and un-overlapped overhead. Gates: per-pair "
+        "byte-identical greedy text across every leg, adaptive tok/s "
+        ">= every grid point under the PR-5 dual gate, >= 1 recorded "
+        "spec_k shrink and >= 1 adaptive-R decision in the flight "
+        "trace, and zero recompiles after warmup (program kinds + "
+        "compile caches stable across the steering bursts)",
+    )
+    p.add_argument(
+        "--adaptive-ab-rounds",
+        type=int,
+        default=2,
+        help="measurement rounds per grid point for --serve-adaptive",
+    )
+    p.add_argument(
         "--serve-trace-overhead",
         action="store_true",
         help="observability A/B leg: the identical panel-shaped burst "
@@ -750,6 +772,8 @@ def main() -> int:
         return _bench_speculative(args, cfg, params, tokens, lengths)
     if args.serve_decode_rounds:
         return _bench_serving_rounds_ab(args, cfg, params)
+    if args.serve_adaptive:
+        return _bench_serving_adaptive(args, cfg, params)
     if args.serve_decode_pipeline:
         return _bench_serving_pipeline_ab(args, cfg, params)
     if args.serve_ragged_attention:
@@ -2285,6 +2309,350 @@ def _bench_serving_rounds_ab(args, cfg, params) -> int:
     )
     if status != "ok":
         print(f"[bench] decode-rounds leg: {status}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _autotune_tally(flight_mod, k_full: int) -> tuple[int, int]:
+    """Count (spec_k shrinks, rounds decisions) currently resident in
+    the flight ring. Called right after warmup AND at the end of the
+    adaptive leg — the ring is bounded evict-oldest, and the lone
+    warmup shrink of an adversarial-draft run can be evicted by an
+    escalated measurement's program events before the final scan."""
+    shrinks = rounds_dec = 0
+    for e in flight_mod.flight_recorder().events():
+        if e.kind != "autotune":
+            continue
+        if (
+            e.meta.get("knob") == "spec_k"
+            and e.meta.get("value", k_full) < k_full
+        ):
+            shrinks += 1
+        if e.meta.get("knob") == "rounds":
+            rounds_dec += 1
+    return shrinks, rounds_dec
+
+
+def _bench_serving_adaptive(args, cfg, params) -> int:
+    """Roofline-adaptive runtime control A/B (PR 15): adaptive mode
+    vs the fixed (spec_k x R) knob grid, on ONE batcher.
+
+    The batcher carries an ADVERSARIAL draft (same config, different
+    random weights — acceptance ~0, the workload where fixed
+    speculation is pure waste) and serves the same mixed greedy burst
+    (half panel mates over one shared header, half unique headers)
+    under each fixed grid point — speculation on at k in {1, K} and
+    off at R in {1, R}, every knob static — then under the adaptive
+    controller, which measures the rejects, shrinks the effective k,
+    disengages speculation entirely (the PR-9 live-flip drain rules),
+    and runs full adaptive-R plain windows, collapsing the final
+    windows as the batch approaches its token budgets.
+
+    Gates (rc 1 on failure, mirrored in ``status``): byte-identical
+    greedy text across EVERY leg pair (the spec/rounds parity
+    contracts compose); adaptive tok/s >= each grid point under the
+    PR-5 dual gate with loadavg-aware escalation; >= 1 recorded
+    spec_k shrink and >= 1 adaptive-R decision among the flight
+    recorder's ``autotune`` events; and zero recompiles after warmup
+    — the device-program KIND set and every compile cache (jit trace
+    counts + chunk/fused wrapper families) stay stable across the
+    steering bursts (the controller's menus are bounded by
+    construction; this proves it).
+    """
+    import jax.numpy as jnp
+
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.serving import flight as _flight
+    from llm_consensus_tpu.serving.continuous import (
+        ContinuousBatcher,
+        ContinuousConfig,
+    )
+    from llm_consensus_tpu.serving.control import (
+        AdaptiveController,
+        ControlConfig,
+    )
+
+    pg = 64
+    R = 4
+    K = max(2, args.k_spec)
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    # ONE admission cohort (n <= slots): every prompt admits up front
+    # and the batch drains together, so near-stop windows happen only
+    # at the burst tail with no chunk riding them — the compiled-trace
+    # set the cache gate compares is deterministic (a mid-burst
+    # admission could otherwise fuse a chunk into a capped window in
+    # one burst and not the next).
+    n = min(args.serve_requests, args.serve_slots)
+    # Off the R grid so the final windows genuinely cap (max remaining
+    # budget < R at the tail => the controller's near-stop decision).
+    nt = args.new_tokens + (R // 2 if args.new_tokens % R == 0 else 0)
+    longest = header_target + 64
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    chunk = args.serve_prefill_chunk or 64
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], nt, max(R, K + 1), pg
+    )
+    n_pages = 1 + args.serve_slots * pages_per_seq * 2
+    header = f"Panel header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    prompts = [
+        (
+            header + " The panel's one question?"
+            if i % 2 == 0
+            else f"Unique header {salt + i}: "
+            + "own context " * (-(-header_target // 12))
+            + f" Q{i}?"
+        )
+        for i in range(n)
+    ]
+
+    # Adversarial draft: same config family (one vocab), different
+    # random weights — proposes garbage, accepts ~nothing. The
+    # workload adaptive control exists for: fixed spec pays full
+    # verify width per round for ~1 token, fixed R=1 pays a dispatch
+    # per token, and only the controller discovers both at runtime.
+    d_params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.bfloat16)
+    ctrl = AdaptiveController(
+        ControlConfig(
+            accept_min_samples=2,
+            # No re-probe during the measured bursts: regrow is the
+            # tier-1 suite's contract; the bench isolates the steady
+            # state (a probe is one spec window + a draft catch-up
+            # replay — correct, but a moving target for the cache
+            # gate).
+            spec_probe_every=100_000,
+            # Slow rounds + depth probes likewise: a probe runs the
+            # losing arm (or a lower depth) for a burst-sized window
+            # on this smoke's sizes, and the grid points it gates
+            # against never pay one — probe robustness is the tier-1
+            # unit suite's contract, steady-state throughput is this
+            # gate.
+            rounds_probe_stretches=100,
+            depth_probe_every=100_000,
+            # Smoke-sized stretches: an R-window burst at this leg's
+            # token budget yields only ~4 countable windows (the
+            # anchor fetch and each arm's first-ever window are
+            # discarded), so the default rounds_stretch_min=5 would
+            # discard EVERY R-arm stretch — the regime could never
+            # calibrate its second arm and would run the cold-start
+            # choice forever.
+            rounds_stretch_windows=8,
+            rounds_stretch_min=3,
+        )
+    )
+    batcher = ContinuousBatcher(
+        cfg,
+        params,
+        config=ContinuousConfig(
+            max_slots=args.serve_slots,
+            page_size=pg,
+            n_pages=n_pages,
+            pages_per_seq=pages_per_seq,
+            max_new_tokens=nt,
+            seq_buckets=tuple(buckets),
+            steps_per_sync=1,
+            prefill_chunk=chunk,
+            share_prefix=True,
+            spec_k=K,
+            decode_rounds=R,
+        ),
+        draft=(cfg, d_params),
+        controller=ctrl,
+    )
+
+    # (tag, spec_decode, spec_k, decode_rounds, adaptive?)
+    GRID = {
+        f"spec-k{K}": (True, K, 1, False),
+        "spec-k1": (True, 1, 1, False),
+        "plain-r1": (False, K, 1, False),
+        f"plain-r{R}": (False, K, R, False),
+        "adaptive": (True, K, R, True),
+    }
+    texts: dict[str, list[str]] = {}
+    runs: dict[str, list[float]] = {tag: [] for tag in GRID}
+
+    def leg(tag):
+        spec_on, k, rounds, adaptive = GRID[tag]
+        # Knob flips are between-bursts events on a quiesced batcher
+        # (the spec/rounds legs' pattern); the controller attaches
+        # only for the adaptive leg, warm across its bursts.
+        batcher.controller = ctrl if adaptive else None
+        batcher.config.spec_decode = spec_on
+        batcher.config.spec_k = k
+        batcher.config.decode_rounds = rounds
+        _quiesce_batcher(batcher)
+        t0 = time.perf_counter()
+        futs = [batcher.submit(p, max_new_tokens=nt) for p in prompts]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        _quiesce_batcher(batcher)
+        texts[tag] = [r.text for r in results]
+        return sum(r.num_tokens for r in results) / wall
+
+    def compile_caches() -> dict:
+        out = {
+            "chunk": len(batcher._jit_chunk),
+            "fused": len(batcher._jit_fused),
+            "chunk_d": len(batcher._jit_chunk_d),
+            "prefill": len(batcher._jit_prefill),
+        }
+        for name in ("_jit_decode", "_jit_rounds", "_jit_spec"):
+            try:
+                out[name] = getattr(batcher, name)._cache_size()
+            except Exception:  # noqa: BLE001 - older jax without it
+                out[name] = -1
+        return out
+
+    def program_kinds(s0, s1) -> set:
+        return {
+            k
+            for k in (
+                "device_programs_fused",
+                "device_programs_decode",
+                "device_programs_prefill",
+                "device_programs_spec",
+                "device_programs_draft",
+            )
+            if s1[k] - s0[k] > 0
+        }
+
+    status = "ok"
+    try:
+        # Warmup: one burst per grid point compiles every fixed trace
+        # family (both spec widths, both round windows, their fused
+        # chunk variants); a half-chunk burst compiles the steering
+        # menu's other width; TWO adaptive bursts let the controller's
+        # EWMAs settle (shrink + disengage land here — the flight scan
+        # covers them) and compile anything steering touches.
+        warm_s0 = batcher.stats()
+        for tag in GRID:
+            leg(tag)
+        batcher.controller = None
+        batcher.config.spec_decode = False
+        batcher.config.decode_rounds = 1
+        half = chunk // 2
+        if half >= 1:
+            batcher.config.prefill_chunk = half
+            leg_prompts = prompts[: max(2, n // 4)]
+            _quiesce_batcher(batcher)
+            for f in [
+                batcher.submit(p, max_new_tokens=nt) for p in leg_prompts
+            ]:
+                f.result(timeout=600)
+            batcher.config.prefill_chunk = chunk
+        # THREE more adaptive bursts: regime calibration (one stretch
+        # per arm — the cut-stretch fold at each burst boundary is
+        # what hands the rate to the arbiter, so calibrating BOTH
+        # arms takes a burst more than the stretch arithmetic alone
+        # suggests) and convergence land in warmup, so the measured
+        # bursts run the settled regime.
+        leg("adaptive")
+        leg("adaptive")
+        leg("adaptive")
+        warm_s1 = batcher.stats()
+        warm_kinds = program_kinds(warm_s0, warm_s1)
+        caches0 = compile_caches()
+        warm_tally = _autotune_tally(_flight, K)
+
+        kinds_new: set = set()
+        for r in range(max(1, args.adaptive_ab_rounds)):
+            for tag in GRID:
+                s0 = batcher.stats()
+                runs[tag].append(leg(tag))
+                if tag == "adaptive":
+                    kinds_new |= program_kinds(s0, batcher.stats())
+        # Escalate like the overhead legs: more full rounds while any
+        # grid point still beats adaptive past the dual gate.
+        extra = 0
+        while any(
+            not _dual_gate_ok(runs[tag], runs["adaptive"])
+            for tag in GRID
+            if tag != "adaptive"
+        ):
+            la, contended = _box_contended()
+            budget = 6 if contended else 3
+            if extra >= budget:
+                break
+            extra += 1
+            print(
+                f"[bench] adaptive: a grid point beats adaptive past "
+                f"the dual gate (loadavg "
+                f"{la if la is None else round(la, 2)}); extra round "
+                f"{extra}/{budget}",
+                file=sys.stderr,
+            )
+            for tag in GRID:
+                runs[tag].append(leg(tag))
+        caches1 = compile_caches()
+    finally:
+        batcher.close()
+
+    ref = texts["adaptive"]
+    diverged = [t for t, tx in texts.items() if tx != ref]
+    # Second scan merged with the post-warmup one via max(): the
+    # shrink typically lands ONCE in early warmup (probes are off),
+    # and an escalated run records enough program events to evict it
+    # from the bounded ring before this final scan — the early scan
+    # is the eviction-proof witness, this one catches late decisions.
+    shrinks, rounds_dec = (
+        max(a, b)
+        for a, b in zip(warm_tally, _autotune_tally(_flight, K))
+    )
+    gates = {
+        tag: _dual_gate_ok(runs[tag], runs["adaptive"])
+        for tag in GRID
+        if tag != "adaptive"
+    }
+    if diverged:
+        status = f"failed: text diverged on legs {diverged}"
+    elif not all(gates.values()):
+        losing = [t for t, ok in gates.items() if not ok]
+        status = f"failed: adaptive lost to grid points {losing}"
+    elif shrinks < 1:
+        status = "failed: no spec_k shrink recorded in the flight trace"
+    elif rounds_dec < 1:
+        status = "failed: no adaptive-R decision in the flight trace"
+    elif caches1 != caches0:
+        status = (
+            f"failed: compile caches grew across the steering bursts "
+            f"({caches0} -> {caches1})"
+        )
+    elif not kinds_new <= warm_kinds:
+        status = (
+            f"failed: new program kinds after warmup "
+            f"({sorted(kinds_new - warm_kinds)})"
+        )
+    best_adaptive = max(runs["adaptive"])
+    best_grid = {
+        tag: round(max(v), 2) for tag, v in runs.items() if tag != "adaptive"
+    }
+    _emit(
+        {
+            "metric": f"serving tok/s, adaptive control ({cfg.name}, "
+            f"{n} mixed reqs x {len(runs['adaptive'])} rounds, "
+            f"slots={args.serve_slots}, K={K}, R={R}, decode {nt} @ "
+            f"~{header_target} prompts, adversarial draft; grid bests "
+            f"{best_grid}, spec_k shrinks {shrinks}, rounds decisions "
+            f"{rounds_dec}, text unchanged={not diverged})",
+            "value": round(best_adaptive, 2),
+            # Unit-tagged like every serving A/B leg (PR 12 rule):
+            # bench_history's regression verdict compares SAME-UNIT
+            # rounds only, so this row never ratios against the
+            # chip's tokens/sec/chip headliners.
+            "unit": "tokens/sec",
+            "vs_baseline": round(
+                best_adaptive / max(max(best_grid.values()), 1e-9), 4
+            ),
+            "status": status,
+        },
+        args.out,
+    )
+    if status != "ok":
+        print(f"[bench] adaptive leg: {status}", file=sys.stderr)
         return 1
     return 0
 

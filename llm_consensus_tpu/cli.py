@@ -169,6 +169,12 @@ def _build_backend(args):
                 "--draft-model on --backend continuous needs --spec-k > 0 "
                 "(draft tokens proposed per verify round)"
             )
+        from llm_consensus_tpu.serving.control import (
+            AdaptiveController,
+            ControlConfig,
+            resolve_hbm_gbps,
+        )
+
         serve_config = ContinuousConfig(
             max_slots=args.serve_slots,
             max_new_tokens=args.max_new_tokens,
@@ -179,8 +185,11 @@ def _build_backend(args):
             ragged_attention=not args.no_ragged_attention,
             spec_k=args.spec_k if draft is not None else 0,
             decode_rounds=args.decode_rounds,
-            hbm_gbps=args.hbm_gbps,
+            # "auto" resolves the roofline peak from the per-platform
+            # table (PR 15); a number passes through unchanged.
+            hbm_gbps=resolve_hbm_gbps(args.hbm_gbps),
         )
+        control = ControlConfig() if args.adaptive else None
         if args.replicas > 1:
             # Prefix-affinity replica fleet (PR 14): K batchers behind
             # the one gateway, routed by resident-chain affinity with
@@ -213,6 +222,7 @@ def _build_backend(args):
                     ),
                     mesh=mesh,
                     draft=draft,
+                    control=control,
                 )
             )
         batcher = ContinuousBatcher(
@@ -222,6 +232,9 @@ def _build_backend(args):
             config=serve_config,
             mesh=mesh,
             draft=draft,
+            controller=(
+                AdaptiveController(control) if control is not None else None
+            ),
         )
         return ContinuousBackend(batcher)
     engine = InferenceEngine(
@@ -319,16 +332,42 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "and requests whose stop sequences have no bounded device "
         "screen collapse the window to 1 while they decode",
     )
+    def _hbm_gbps_arg(v: str) -> str:
+        # Validate at parse time (argparse's clean usage error, not a
+        # traceback mid-backend-build) but RETURN the string:
+        # resolving "auto" needs jax.devices(), which must not run
+        # before --cpu has had its chance to pin the platform.
+        if v.strip().lower() != "auto":
+            float(v)  # raises ValueError -> argparse "invalid value"
+        return v
+
     p.add_argument(
         "--hbm-gbps",
-        type=float,
-        default=0.0,
+        type=_hbm_gbps_arg,
+        default="0",
         help="continuous backend: the device's peak HBM bandwidth in "
         "GB/s for roofline attribution — > 0 publishes "
         "gateway_program_mbu{kind} (modeled program HBM bytes / "
         "measured wall time / this peak; ~1.0 = at the weights+KV "
-        "roofline). 0 = gauge off; the modeled-bytes and measured-"
-        "seconds sums still accumulate in the batcher's stats()",
+        "roofline). 'auto' resolves it from a per-platform table "
+        "(TPU v4/v5e/v5p + a CPU-smoke sentinel; unresolvable warns "
+        "once and disables MBU-driven adaptive decisions — "
+        "acceptance/overhead steering keeps working). 0 = gauge off; "
+        "the modeled-bytes and measured-seconds sums still "
+        "accumulate in the batcher's stats()",
+    )
+    p.add_argument(
+        "--adaptive",
+        action="store_true",
+        help="continuous backend: roofline-adaptive runtime control "
+        "(PR 15) — auto-tune effective spec_k from measured per-group "
+        "acceptance, decode-round windows from modeled MBU + token "
+        "budgets, prefill-chunk width and pipeline depth from "
+        "un-overlapped scheduler overhead, and pace preempt-to-host-"
+        "tier demotions by modeled restore debt. Decisions ride "
+        "gateway_autotune_* and the flight recorder; text stays "
+        "byte-identical to any fixed knob setting (default off = "
+        "every knob static)",
     )
     p.add_argument(
         "--cpu",
@@ -576,6 +615,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="concurrent in-flight executions across priorities",
     )
     p.add_argument(
+        "--admission-cost-budget-mb",
+        type=int,
+        default=0,
+        help="cost-budget admission (PR 15): switch every queue bound "
+        "from request counts to MODELED BYTES — each request charges "
+        "its modeled KV schedule (the same unit the fleet router's "
+        "load_cost compares), so a 32k-context request is no longer "
+        "one unit of work and the overflow hard cap is bytes too. "
+        "0 = classic request-count bounds (--queue-bound)",
+    )
+    p.add_argument(
         "--default-deadline-s",
         type=float,
         default=None,
@@ -674,6 +724,9 @@ def _run_serve(argv: list[str]) -> int:
                 max_queue=args.queue_bound,
                 max_inflight=args.max_inflight,
                 default_deadline_s=args.default_deadline_s,
+                cost_budget_bytes=float(
+                    args.admission_cost_budget_mb << 20
+                ),
             ),
             sampling=SamplingParams(
                 max_new_tokens=args.max_new_tokens,

@@ -95,7 +95,20 @@ class AdmissionConfig:
     # backpressure (a stale preempt signal + a mega-storm must
     # eventually shed fast 429s instead of queueing requests to
     # deadline death and growing queue memory with offered load).
+    # UNIT NORMALIZATION (PR 15): the factor multiplies whatever unit
+    # the bound itself uses — requests in classic mode, MODELED BYTES
+    # in cost-budget mode — so the hard-cap path can never again mix
+    # a bytes-denominated preempt signal with a request-count cap.
     max_overflow_factor: int = 16
+    # Cost-budget admission (PR 15): > 0 switches every queue bound
+    # from request COUNTS to MODELED BYTES — the same unit the fleet
+    # router's load_cost compares and ContinuousBatcher.
+    # modeled_request_cost prices (a 32k-context request is not one
+    # unit of work). Each submit carries its modeled cost; a request
+    # without one is priced at one nominal slot
+    # (budget / bound_for(priority)). 0 (default) = classic
+    # request-count bounds.
+    cost_budget_bytes: float = 0.0
 
     def bound_for(self, priority: str) -> int:
         if isinstance(self.max_queue, dict):
@@ -115,6 +128,10 @@ class _Item:
     # so the trace must ride the item and be re-installed around the
     # thunk (tracing.use_trace) for downstream spans to attach.
     trace: object | None = None
+    # Modeled cost in bytes (PR 15, cost-budget mode): charged to the
+    # priority's queue-cost account while queued, released at dispatch
+    # or expiry. 0 in classic request-count mode.
+    cost: float = 0.0
 
 
 class AdmissionController:
@@ -131,6 +148,13 @@ class AdmissionController:
         reg = registry or _metrics.REGISTRY
         self._queues: dict[str, deque[_Item]] = {
             p: deque() for p in self.config.priorities
+        }
+        # Modeled bytes queued per priority (PR 15 cost-budget mode):
+        # charged at append, released at every popleft site — the
+        # bound AND the overflow hard cap read this one account, so
+        # the two can never drift units.
+        self._queue_cost: dict[str, float] = {
+            p: 0.0 for p in self.config.priorities
         }
         self._inflight = 0
         self._draining = False
@@ -173,6 +197,10 @@ class AdmissionController:
             "gateway_queue_wait_seconds",
             "Time from admission to dispatch",
         )
+        self._m_cost = reg.gauge(
+            "gateway_queue_cost_bytes",
+            "Modeled bytes waiting for admission (cost-budget mode)",
+        )
 
     # -- admission ------------------------------------------------------
 
@@ -190,6 +218,7 @@ class AdmissionController:
         *,
         priority: str | None = None,
         deadline_s: float | None = None,
+        cost: float | None = None,
     ):
         """Admit ``thunk`` and await its terminal outcome.
 
@@ -197,6 +226,15 @@ class AdmissionController:
         door, :class:`DeadlineExpiredError` when the deadline passes
         (queued or in-flight), else returns/raises whatever the awaited
         thunk does.
+
+        ``cost`` (PR 15): the request's modeled bytes
+        (``ContinuousBatcher.modeled_request_cost`` — the unit
+        ``load_cost`` routes on). Read only in cost-budget mode
+        (``AdmissionConfig.cost_budget_bytes > 0``), where the queue
+        bound, the overflow hard cap, and the shed decision all
+        compare in modeled bytes; a costless submit is priced at one
+        nominal slot (budget / bound) so legacy callers keep
+        approximately the classic depth bound.
         """
         prio = priority or self.config.priorities[0]
         q = self._queues.get(prio)
@@ -207,13 +245,30 @@ class AdmissionController:
         if self._draining:
             raise DrainingError("gateway is draining; not admitting")
         bound = self.config.bound_for(prio)
-        if len(q) >= bound:
+        budget = self.config.cost_budget_bytes
+        factor = self.config.max_overflow_factor
+        if budget > 0:
+            # Cost-budget mode: bound and hard cap in ONE unit,
+            # modeled bytes — a 32k-context request charges what it
+            # costs, N small ones fit where one huge one would not.
+            # An EMPTY queue always admits (classic mode's invariant):
+            # the budget bounds the BACKLOG, never a single request's
+            # size — a request whose lone modeled cost exceeds the
+            # budget must not be unservable forever on an idle
+            # gateway.
+            if cost is None or cost <= 0:
+                cost = budget / max(1, bound)
+            queued = self._queue_cost[prio]
+            over = len(q) > 0 and queued + cost > budget
+            capped = len(q) > 0 and queued + cost > budget * factor
+        else:
+            cost = 0.0
+            over = len(q) >= bound
+            capped = len(q) >= bound * factor
+        if over:
             hook = self.overflow_hook
             preempted = False
-            if (
-                hook is not None
-                and len(q) < bound * self.config.max_overflow_factor
-            ):
+            if hook is not None and not capped:
                 try:
                     preempted = bool(hook())
                 except Exception:  # noqa: BLE001 - hook must not 500
@@ -230,10 +285,13 @@ class AdmissionController:
             deadline=(now + deadline_s) if deadline_s is not None else None,
             enqueued_at=now,
             trace=_tracing.current_trace(),
+            cost=cost,
         )
         q.append(item)
+        self._queue_cost[prio] += item.cost
         self._m_admitted.labels(priority=prio).inc()
         self._m_depth.labels(priority=prio).set(len(q))
+        self._m_cost.labels(priority=prio).set(self._queue_cost[prio])
         self._idle.clear()
         self._ensure_dispatcher()
         self._work.set()
@@ -268,6 +326,7 @@ class AdmissionController:
             q = self._queues[prio]
             while q:
                 item = q.popleft()
+                self._release_cost(item)
                 self._m_depth.labels(priority=prio).set(len(q))
                 if item.future.done():
                     # Caller gave up while queued (e.g. an aborted SSE
@@ -281,6 +340,16 @@ class AdmissionController:
                     continue
                 return item
         return None
+
+    def _release_cost(self, item: _Item) -> None:
+        """Release a dequeued item's modeled-cost charge (every
+        popleft site calls this exactly once — the account mirrors
+        queue membership, nothing else)."""
+        if item.cost:
+            c = self._queue_cost[item.priority] = max(
+                0.0, self._queue_cost[item.priority] - item.cost
+            )
+            self._m_cost.labels(priority=item.priority).set(c)
 
     def _expire(self, item: _Item) -> None:
         self._m_expired.labels(priority=item.priority).inc()
@@ -304,6 +373,7 @@ class AdmissionController:
             for _ in range(len(q)):
                 item = q.popleft()
                 if item.deadline is not None and item.deadline <= now:
+                    self._release_cost(item)
                     self._expire(item)
                 else:
                     q.append(item)

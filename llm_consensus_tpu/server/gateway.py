@@ -671,6 +671,27 @@ class Gateway:
             stop=tuple(stop),
         )
 
+    def _cost_kw(
+        self, adm_kw: dict, prompt: str, max_new_tokens: int, members: int = 1
+    ) -> dict:
+        """Attach the request's modeled cost (PR 15) when the
+        admission controller runs in cost-budget mode and the backend
+        can price it (``request_cost`` — the continuous batcher's
+        modeled bytes, the same unit the fleet router's load_cost
+        compares). ``members``: a consensus panel fans one question
+        into N generations, so it costs N times the single prompt.
+        Pricing failures fall back to the controller's nominal-slot
+        default rather than 500ing the request."""
+        if self.admission.config.cost_budget_bytes <= 0:
+            return adm_kw
+        rc = getattr(self.backend, "request_cost", None)
+        if callable(rc):
+            try:
+                adm_kw["cost"] = float(rc(prompt, max_new_tokens)) * members
+            except Exception:  # noqa: BLE001 - pricing must not 500
+                log.exception("request_cost failed; using nominal cost")
+        return adm_kw
+
     @staticmethod
     def _admission_kw(payload: dict, default_priority: str) -> dict:
         kw = {"priority": payload.get("priority", default_priority)}
@@ -700,7 +721,11 @@ class Gateway:
                 params=self._sampling_from(payload),
                 model=payload.get("model"),
             )
-            adm_kw = self._admission_kw(payload, "interactive")
+            adm_kw = self._cost_kw(
+                self._admission_kw(payload, "interactive"),
+                prompt,
+                req.params.max_new_tokens,
+            )
         except (TypeError, ValueError, OverflowError) as e:
             await self._respond_json(
                 writer, 400, {"error": f"bad request field: {e}"}
@@ -914,7 +939,12 @@ class Gateway:
                 seed=payload.get("seed", self.config.consensus_seed),
                 sampling=self._sampling_from(payload),
             )
-            adm_kw = self._admission_kw(payload, "batch")
+            adm_kw = self._cost_kw(
+                self._admission_kw(payload, "batch"),
+                question,
+                cfg.sampling.max_new_tokens,
+                members=max(1, len(self.panel)),
+            )
         except (TypeError, ValueError, OverflowError) as e:
             await self._respond_json(
                 writer, 400, {"error": f"bad request field: {e}"}

@@ -766,6 +766,38 @@ REPLICA_SHARED_STORE_BYTES = REGISTRY.gauge(
 
 
 # ---------------------------------------------------------------------------
+# Roofline-adaptive runtime control (PR 15, serving/control.py): the
+# PR-10 cost model closed into a feedback loop. Labeled
+# ``knob="spec_k"|"rounds"|"chunk"|"depth"``. Process-global like
+# gateway_device_programs_total: a replica FLEET's controllers all
+# write the same families (last writer wins on the gauge) — the
+# per-replica split lives in the fleet stats() ``per_replica`` list,
+# whose batcher stats carry each controller's ``autotune_*`` mirrors,
+# exactly the PR-14 convention for the per-replica program counts.
+# ---------------------------------------------------------------------------
+
+#: One increment per knob decision that CHANGED the knob's value
+#: (steady-state re-decisions are silent, like spec_flip flight
+#: events): spec_k shrink/regrow/disengage (value 0 = speculation
+#: disengaged until a probe re-accepts), an adaptive-R window cap, a
+#: chunk-width flip, a pipeline-depth probe/commit/revert. Mirrored in
+#: the batcher's stats() as ``autotune_decisions_<knob>`` (lockstep
+#: tested); each change is also an ``autotune`` flight event.
+AUTOTUNE_DECISIONS = REGISTRY.counter(
+    "gateway_autotune_decisions_total",
+    "Adaptive-controller knob decisions that changed a knob value",
+)
+#: The last decided effective value per knob (spec_k's 0 =
+#: disengaged). Pinned knobs (ControlConfig.tune_* = False) never set
+#: their label. stats() mirror: ``autotune_<knob>`` (-1 = no decision
+#: yet).
+AUTOTUNE_VALUE = REGISTRY.gauge(
+    "gateway_autotune_value",
+    "Last effective knob value decided by the adaptive controller",
+)
+
+
+# ---------------------------------------------------------------------------
 # Canonical manifest of families created on PER-INSTANCE registries
 # (gateway/admission accept an isolated MetricsRegistry for test
 # isolation, so their families cannot be module-level objects here).
@@ -785,6 +817,7 @@ INSTANCE_FAMILIES: dict[str, str] = {
     "gateway_deadline_expired_total": "counter",
     "gateway_completed_total": "counter",
     "gateway_queue_wait_seconds": "histogram",
+    "gateway_queue_cost_bytes": "gauge",
 }
 
 

@@ -628,6 +628,13 @@ class _Inflight:
     # device-resident, host count/draft-lag mirrors sync at fetch).
     # 0: a legacy program whose host mirrors advanced at dispatch.
     rounds: int = 0
+    # Whether this window's length was the rounds controller's CHOICE
+    # (PR 15) rather than forced by a near-stop cap or an
+    # unscreenable-stop collapse — only chosen windows feed the
+    # per-arm measured-rate EWMAs (a forced tail window would
+    # attribute its frozen rows' starvation to an arm that never
+    # chose it).
+    rounds_clean: bool = False
     # -- flight recorder + roofline attribution (PR 10) -----------------
     # The "program" flight event recorded at dispatch: the fetch fills
     # its (t0, dur) window in place once the true device window is
@@ -651,12 +658,24 @@ class ContinuousBatcher:
         draft: tuple[ModelConfig, dict] | None = None,
         host_store: HostPageStore | None = None,
         host_store_scope: tuple | None = None,
+        controller=None,
     ):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
         self.config = config or ContinuousConfig()
         c = self.config
+        # Roofline-adaptive runtime control (PR 15,
+        # serving/control.py): an AdaptiveController closing the PR-10
+        # cost model into a feedback loop — effective spec_k per
+        # dispatch from measured per-group acceptance, adaptive-R
+        # window caps, chunk/depth steering from un-overlapped
+        # overhead and modeled MBU, restore pacing for the fleet's
+        # preempt hook. None (default) = every knob stays its static
+        # config value (the pre-PR-15 behavior, and the bench's
+        # fixed-grid baseline). Bound below once the modeled terms
+        # exist.
+        self.controller = controller
         # Speculative draft model (PR 9): the draft decodes against its
         # OWN pool mirroring the target's page geometry — same page
         # ids, same host-side tables/allocator, so prefix sharing, CoW
@@ -946,6 +965,20 @@ class ContinuousBatcher:
             self._draft_kv_token_bytes = kv_plane_token_bytes(
                 self._draft_cfg, self.draft_cache.k.dtype
             )
+        if self.controller is not None:
+            # Static modeled terms the controller's roofline clauses
+            # read: the weight tree as it sits in HBM, the KV
+            # byte-per-token unit (cost-dict KV splits), the
+            # configured peak, and the host tier's budget (restore-
+            # pacing debt cap).
+            self.controller.bind(
+                hbm_gbps=c.hbm_gbps,
+                weight_bytes=self._weight_bytes,
+                kv_token_bytes=self._kv_token_bytes,
+                host_budget_bytes=(
+                    c.host_cache_bytes if self._offload is not None else 0
+                ),
+            )
         self._mbu = {
             kind: {
                 "hbm_bytes": 0,
@@ -1137,8 +1170,15 @@ class ContinuousBatcher:
     def _depth(self) -> int:
         """Decode programs allowed in flight (>= 1). Read per loop
         iteration, so a depth change between bursts takes effect
-        without restarting the batcher (the bench's A/B lever)."""
-        return max(1, self.config.pipeline_depth)
+        without restarting the batcher (the bench's A/B lever). With
+        an adaptive controller the effective depth steers within
+        [1, pipeline_depth] from the un-overlapped overhead signal
+        (PR 15) — outputs are depth-invariant by the PR-6 contract,
+        so steering can never change text."""
+        d = max(1, self.config.pipeline_depth)
+        if self.controller is not None:
+            d = max(1, min(d, self.controller.depth_for(d)))
+        return d
 
     @property
     def _spec_ok(self) -> bool:
@@ -1591,8 +1631,11 @@ class ContinuousBatcher:
         )[:, 0]
         return emit, emit_cnt, cache, dcache, next_in, counts + emit_cnt
 
-    def _spec_stream_plan(self, rows_now):
+    def _spec_stream_plan(self, rows_now, k: int | None = None):
         """Host-side shared-draft-stream planning for one round.
+        ``k``: this dispatch's EFFECTIVE spec window (PR 15's
+        controller may shrink it below config.spec_k; the fill matrix
+        and offsets size to what the program will actually verify).
 
         Per shared-prefix bucket (GroupTracker first-page buckets — the
         panel over one header), the member with the LONGEST committed
@@ -1619,7 +1662,8 @@ class ContinuousBatcher:
         a round, never wrong output).
         """
         c = self.config
-        k = c.spec_k
+        if k is None:
+            k = c.spec_k
         n = c.max_slots
         src = np.arange(n, dtype=np.int32)
         off = np.zeros((n,), np.int32)
@@ -2035,9 +2079,39 @@ class ContinuousBatcher:
                     L = s.prompt_len
                 total += rem * L + rem * (rem - 1) // 2 + rem
             for r in self._waiting:
-                L, rem = len(r.prompt_ids), r.max_new_tokens
-                total += L + rem * L + rem * (rem - 1) // 2 + rem
+                # A waiting request's whole schedule: the SAME tokens
+                # modeled_request_cost charges at the admission door
+                # (one formula, two surfaces — the unit-normalization
+                # contract of PR 15's cost-budget admission).
+                total += self._cost_tokens(len(r.prompt_ids), r.max_new_tokens)
         return float(total * kvb)
+
+    @staticmethod
+    def _cost_tokens(L: int, rem: int) -> int:
+        """KV-token units of one not-yet-started request's whole
+        schedule: L prefill writes, then rem decode steps each reading
+        the full committed context (L + j at step j) and writing one
+        token — THE formula load_cost integrates and
+        modeled_request_cost prices, kept in one place so the router
+        and the admission bound can never drift units."""
+        return L + rem * L + rem * (rem - 1) // 2 + rem
+
+    def modeled_request_cost(
+        self, prompt_tokens: int, max_new_tokens: int | None = None
+    ) -> float:
+        """Modeled HBM bytes of one request's whole schedule — the
+        cost-budget admission unit (PR 15). Deliberately the same
+        KV-term formula and byte unit as :meth:`load_cost`, so the
+        gateway queue bound, the overflow hard cap, and the fleet
+        router's least-loaded comparison all speak modeled bytes: a
+        32k-context request weighs what it costs, not one unit of
+        queue depth."""
+        c = self.config
+        if max_new_tokens is None:
+            max_new_tokens = c.max_new_tokens
+        L = max(1, min(int(prompt_tokens), c.seq_buckets[-1]))
+        kvb = self._kv_token_bytes + self._draft_kv_token_bytes
+        return float(self._cost_tokens(L, int(max_new_tokens)) * kvb)
 
     def waiting_depth(self) -> int:
         """Requests admitted to this batcher but not yet slotted — the
@@ -2131,6 +2205,12 @@ class ContinuousBatcher:
                 freed += reg.evict(n - freed)
             self._preempted_pages += freed
         if freed:
+            if self.controller is not None:
+                # Restore-pacing debt (PR 15): preempt-demoted bytes
+                # that the restore path will have to repay.
+                self.controller.note_preempt_demote(
+                    freed * self.host_page_bytes
+                )
             _flight.flight_recorder().record(
                 "preempt", time.perf_counter(), pages=freed
             )
@@ -2321,6 +2401,15 @@ class ContinuousBatcher:
                         "programs",
                     )
                 },
+                # Adaptive control (PR 15): the controller's own
+                # mirrors of gateway_autotune_value/_decisions_total —
+                # absent without a controller (the knobs are static
+                # config then, and a missing key is honest about it).
+                **(
+                    self.controller.stats()
+                    if self.controller is not None
+                    else {}
+                ),
             }
 
     def close(self) -> None:
@@ -2350,6 +2439,17 @@ class ContinuousBatcher:
         return sum(
             s is not None and s.phase == "decode" for s in self._slots
         )
+
+    @staticmethod
+    def _group_key(slot: _Slot) -> int:
+        """A slot's shared-prefix group identity for the adaptive
+        controller (PR 15): its FIRST table page — panel mates mapping
+        one registered header share it (the GroupTracker bucket key's
+        first element), unique prompts each own theirs. Page-id
+        recycling can alias groups across time; the acceptance EWMA is
+        advisory, so staleness costs one wrong-k window, never
+        correctness."""
+        return int(slot.pages[0]) if slot.pages else -1
 
     def _bucket(self, n: int) -> int:
         return _next_bucket(n, self.config.seq_buckets)
@@ -2387,10 +2487,15 @@ class ContinuousBatcher:
         # prefill_end: last position (+1) the chunked prefill may touch
         # — a shared-prefix start off the chunk grid can overhang the
         # bucket by up to chunk-1 positions of masked padding garbage.
+        # Depth counts from the CONFIG, not the adaptive effective
+        # depth (PR 15): a row admitted while the controller steered
+        # depth low must stay budgeted when it steers back up —
+        # exactly the live-flip rule _round_tokens already applies to
+        # spec_k and decode_rounds.
         total = (
             max(bucket, prefill_end)
             + req.max_new_tokens
-            + self._depth * self._round_tokens
+            + max(1, self.config.pipeline_depth) * self._round_tokens
             - 1
         )
         pg = self.config.page_size
@@ -2462,6 +2567,14 @@ class ContinuousBatcher:
         pg = c.page_size
         bucket = self._bucket(L)
         chunk = self._chunk_width(bucket)
+        if self.controller is not None:
+            # Chunk steering (PR 15): the effective width for THIS
+            # admission, from the menu {chunk, chunk/2} (chunk_for
+            # guarantees the half still divides the bucket — the
+            # unshared-footprint invariant — so at most ONE extra
+            # compiled (chunk, bucket) trace per bucket can ever
+            # exist: the no-recompile-storm bound).
+            chunk = min(chunk, max(1, self.controller.chunk_for(bucket, chunk)))
 
         # One candidate slot per SHARD: every slot of a shard draws on
         # the same pool/registry, so retrying a failed plan on a
@@ -2819,6 +2932,8 @@ class ContinuousBatcher:
         )
         node.ready = True
         _M_OFF_RESTORED.inc()
+        if self.controller is not None:
+            self.controller.note_restore(self.host_page_bytes)
         with self._lock:
             self._offload_restored += 1
         return True
@@ -2958,6 +3073,11 @@ class ContinuousBatcher:
         into the per-kind accumulators and — with a configured peak
         bandwidth — the gateway_program_mbu{kind} gauge. One site,
         two surfaces (stats mbu_* mirrors; lockstep tested)."""
+        if self.controller is not None:
+            # Roofline-position feed (PR 15): modeled weight fraction
+            # + decode-MBU EWMAs come from the same (cost, dur) pairs
+            # the gauge and stats sums fold.
+            self.controller.note_program(kind, cost, dur)
         if cost is None:
             return
         with self._lock:
@@ -3453,6 +3573,7 @@ class ContinuousBatcher:
         chunk_idx: int | None = None,
         spec: bool = False,
         rounds: int = 1,
+        rounds_choice: bool = False,
     ) -> None:
         """Enqueue ONE decode program for the current decode batch.
 
@@ -3491,6 +3612,17 @@ class ContinuousBatcher:
         device-resident count threading as a spec round; the
         per-dispatch effective window may still collapse to 1
         (:meth:`_stop_plan`) without leaving the rounds counts-mode.
+
+        ``rounds_choice`` (PR 15): this dispatch's ``rounds`` was the
+        adaptive controller's FREE regime choice (not a near-stop
+        force) — such windows are evidence for the two-arm rate
+        arbitration. An adaptive arm-1 window is a PLAIN legacy
+        dispatch (``rounds == 1``): the masked 1-round program would
+        pay the masking machinery + an extra emit-count host fetch
+        the plain program doesn't, and the whole point of the arm is
+        to measure what single-round dispatch really costs — the
+        mode-flush rules above already drain the pipeline on the
+        counts-mode change.
         """
         c = self.config
         k = self._sync_chunk
@@ -3535,6 +3667,10 @@ class ContinuousBatcher:
             overhead = 0.0
         if overhead is not None:
             _M_SCHED_OVERHEAD.observe(overhead)
+            if self.controller is not None:
+                # Chunk/depth steering signal (PR 15): the same
+                # un-overlapped observation the histogram gets.
+                self.controller.note_overhead(overhead)
             with self._lock:
                 self._sched_overhead_sum += overhead
                 self._sched_overhead_count += 1
@@ -3564,6 +3700,24 @@ class ContinuousBatcher:
             tokens = rows(self._last_tokens)
         self._tok_dirty[:] = False
         if spec:
+            # Effective spec window (PR 15): the controller shrinks k
+            # within [1, spec_k] from per-group measured acceptance —
+            # menu {1, spec_k}, so the _jit_spec trace family stays
+            # two entries. Everything downstream (stream plan, cost
+            # model, drafted counter, the _Inflight record the fetch's
+            # acceptance accounting divides by) uses THIS k.
+            k_spec = c.spec_k
+            if self.controller is not None:
+                k_spec = max(
+                    1,
+                    min(
+                        c.spec_k,
+                        self.controller.spec_k_for(
+                            [self._group_key(s) for _, s in rows_now],
+                            c.spec_k,
+                        ),
+                    ),
+                )
             # Device-resident PRNG counts: the previous spec program's
             # counts_out (data-dependent — the host can't advance them
             # at dispatch), with (re)activated rows patched from the
@@ -3572,7 +3726,7 @@ class ContinuousBatcher:
             # ever chains spec outputs.
             counts_dev = self._counts_device_arg(dirty_np, rows)
             src, fill, off, streams, shared = self._spec_stream_plan(
-                rows_now
+                rows_now, k_spec
             )
             # Flight events for stream-plan CHANGES only (the plan
             # itself re-runs every round): a mate picking up a new
@@ -3592,7 +3746,7 @@ class ContinuousBatcher:
                 self._stream_src_prev[i] = cur
             emit, emit_cnt, self.cache, self.draft_cache, next_in, cnt_out = (
                 self._jit_spec(
-                    c.spec_k,
+                    k_spec,
                     self.params,
                     self._draft_params,
                     self.cache,
@@ -3618,9 +3772,9 @@ class ContinuousBatcher:
             # device-rounds algebra the decode_rounds leg gates on).
             ev = self._count_program("spec", rows=len(rows_now), rounds=1)
             cost = self._program_cost(
-                "spec", rows_now, c.spec_k, streams=streams
+                "spec", rows_now, k_spec, streams=streams
             )
-            drafted = c.spec_k * streams
+            drafted = k_spec * streams
             _M_SPEC_DRAFTED.inc(drafted)
             with self._lock:
                 self._spec_drafted += drafted
@@ -3634,7 +3788,7 @@ class ContinuousBatcher:
                 k=1,
                 rows=rows_now,
                 spec=True,
-                spec_k=c.spec_k,
+                spec_k=k_spec,
                 emit_cnt=emit_cnt,
                 counts_out=cnt_out,
                 flight=ev,
@@ -3656,9 +3810,22 @@ class ContinuousBatcher:
         counts_arg = None
         budgets_dev = screen_dev = None
         emit_cnt = cnt_out = None
+        if self.controller is not None and self._draft_cfg is not None:
+            # Probe clock for a disengaged spec controller: plain
+            # windows counted at the dispatch site (idle loop
+            # iterations must not advance it).
+            self.controller.note_plain_window()
+        rounds_clean = rounds_choice and rounds == 1
         if R > 1:
             counts_arg = self._counts_device_arg(dirty_np, rows)
             budgets_np, screen_np, rounds_now = self._stop_plan(rows_now, R)
+            if rounds_choice:
+                # Chosen full window (PR 15): clean unless the stop
+                # plan collapsed it (an unscreenable stop is forced,
+                # not evidence about the window arms). The regime
+                # choice itself happened in _run, at the same
+                # once-per-iteration altitude as the engage state.
+                rounds_clean = rounds_now == R
             budgets_dev = jnp.asarray(budgets_np)
             screen_dev = jnp.asarray(screen_np)
             k = rounds_now
@@ -3778,6 +3945,7 @@ class ContinuousBatcher:
         rec = _Inflight(
             tokens=next_tok, next_input=next_in, t0=t0, k=k,
             rows=rows_now, chunk=chunk_rec, rounds=rounds_now,
+            rounds_clean=rounds_clean,
             emit_cnt=emit_cnt, counts_out=cnt_out, flight=ev, cost=cost,
         )
         self._dispatch_tail(rec, groups, k)
@@ -3882,6 +4050,7 @@ class ContinuousBatcher:
             # count and marked it dirty, so the mirror stays right.
             emitted = 0
             accepted = 0
+            accept_samples = []
             for i, s in alive:
                 n = int(cnt_np[i])
                 self._counts[i] += n
@@ -3891,6 +4060,15 @@ class ContinuousBatcher:
                 # accepted per round" line of the request summary).
                 s.spec_rounds += 1
                 s.spec_accepted_toks += n - 1
+                accept_samples.append(
+                    (self._group_key(s), n - 1, rec.spec_k)
+                )
+            if self.controller is not None and accept_samples:
+                # Per-group acceptance EWMAs (PR 15) — fed from the
+                # SAME per-row counts gateway_spec_acceptance's
+                # fraction aggregates, keyed by the GroupTracker
+                # bucket identity.
+                self.controller.note_spec_round(accept_samples)
             if alive:
                 _M_SPEC_ACCEPTED.inc(accepted)
                 frac = accepted / (rec.spec_k * len(alive))
@@ -3951,6 +4129,22 @@ class ContinuousBatcher:
             with self._lock:
                 self._tbt_sum += tbt_sum
                 self._tbt_count += tbt_count
+        if (
+            self.controller is not None
+            and not rec.spec
+            and rec.rows
+            and self.config.decode_rounds > 1
+        ):
+            # Two-arm rounds feed (PR 15): this window's realized
+            # emissions, attributed to the running regime (a plain
+            # window is the arm-1 regime while the controller
+            # arbitrates; rec.rounds_clean says whether the length
+            # was chosen or forced).
+            self.controller.note_rounds_window(
+                rec.rounds if rec.rounds else 1,
+                emitted_total,
+                clean=rec.rounds_clean,
+            )
         if rec.flight is not None:
             # Replace, never mutate: a concurrent export may hold the
             # old meta dict.
@@ -4014,6 +4208,20 @@ class ContinuousBatcher:
             # the verify program IS the decode dispatch, and a chunk
             # lane on it is future work.
             spec_now = self._spec_ok
+            if spec_now and self.controller is not None:
+                # Adaptive spec gate (PR 15): the controller may
+                # DISENGAGE speculation when every decoding group's
+                # measured acceptance sits below the floor (and
+                # re-probe periodically). The flip takes the same
+                # drain + catch-up path as a live spec_decode flip —
+                # the PR-9 rules this composes with.
+                spec_now = self.controller.spec_gate(
+                    [
+                        self._group_key(s)
+                        for s in self._slots
+                        if s is not None and s.phase == "decode"
+                    ]
+                )
             # Multi-round engage state, read ONCE per iteration next to
             # spec_now and threaded into _dispatch the same way: the
             # mode-flush decision and the dispatched program must come
@@ -4022,6 +4230,37 @@ class ContinuousBatcher:
             # window (a flip is a between-bursts event, but the
             # scheduler must stay correct if one lands mid-burst).
             rounds_now = 1 if spec_now else self._rounds
+            rounds_choice = False
+            if rounds_now > 1 and self.controller is not None:
+                # Roofline-adaptive R (PR 15): the controller's
+                # two-arm regime choice over {plain 1-round, R-round
+                # window}, consulted at the same once-per-iteration
+                # altitude as the engage state itself — an arm-1
+                # choice dispatches PLAIN programs (the mode flush
+                # below drains on the transition, bounded by the
+                # stretch cadence), an arm-R choice keeps the masked
+                # window, and a batch about to retire forces 1 (the
+                # masked tail rounds would decode nothing). Byte
+                # parity vs any fixed R is the PR-12 masking
+                # contract; the {1, R} menu adds ZERO compiled
+                # traces.
+                max_rem = max(
+                    (
+                        s.request.max_new_tokens - len(s.generated)
+                        for s in self._slots
+                        if s is not None and s.phase == "decode"
+                    ),
+                    default=0,
+                )
+                cap = max(
+                    1,
+                    min(
+                        rounds_now,
+                        self.controller.rounds_cap(max_rem, rounds_now),
+                    ),
+                )
+                rounds_choice = max_rem >= rounds_now
+                rounds_now = cap
             if self._draft_cfg is not None:
                 # Flight event on TRANSITIONS only (spec_decode is read
                 # per iteration; steady state records nothing).
@@ -4099,6 +4338,7 @@ class ContinuousBatcher:
                     chunk_idx if fused else None,
                     spec=spec_now,
                     rounds=rounds_now,
+                    rounds_choice=rounds_choice,
                 )
                 while len(self._inflight) >= self._depth:
                     self._fetch_one()
@@ -4182,6 +4422,17 @@ class ContinuousBackend(_backend_base.Backend):
     def health(self) -> dict:
         """Gateway readiness probe surface: the batcher heartbeat."""
         return self.batcher.heartbeat()
+
+    def request_cost(self, prompt: str, max_new_tokens: int) -> float:
+        """Modeled bytes of one request's whole schedule — the
+        gateway's cost-budget admission consults this (PR 15) so its
+        queue bound counts the same unit the router's load_cost
+        compares. Tokenizes once (ByteTokenizer is O(len) on the
+        event loop; the submit path re-encodes — correctness over a
+        cached double-encode here)."""
+        return self.batcher.modeled_request_cost(
+            len(self.batcher.tokenizer.encode(prompt)), max_new_tokens
+        )
 
     async def close(self) -> None:
         self.batcher.close()

@@ -354,6 +354,7 @@ class ReplicaSet:
         mesh=None,
         meshes: list | None = None,
         draft: tuple[ModelConfig, dict] | None = None,
+        control=None,
     ):
         self.cfg = cfg
         self.config = config or ContinuousConfig()
@@ -383,6 +384,19 @@ class ReplicaSet:
         self.batchers: list[ContinuousBatcher] = []
         scope: tuple | None = None
         for i in range(k):
+            # Adaptive control (PR 15): ``control`` is a ControlConfig
+            # — each replica gets ITS OWN AdaptiveController (the
+            # acceptance/overhead/MBU signals are per-replica streams;
+            # one shared controller would average incomparable
+            # workloads). None = every knob static, the pre-PR-15
+            # fleet.
+            ctrl = None
+            if control is not None:
+                from llm_consensus_tpu.serving.control import (
+                    AdaptiveController,
+                )
+
+                ctrl = AdaptiveController(control)
             b = ContinuousBatcher(
                 cfg,
                 params,
@@ -395,6 +409,7 @@ class ReplicaSet:
                 # every param leaf); its siblings share the identical
                 # cfg/params, so they reuse it instead of re-walking.
                 host_store_scope=scope,
+                controller=ctrl,
             )
             if self.store is not None and scope is None:
                 scope = b._store_scope
@@ -518,9 +533,21 @@ class ReplicaSet:
         if victim is None and len(store) == 0:
             return False
         if victim is not None:
-            self.batchers[victim].request_preempt(
-                min(pages, self.fleet_config.preempt_pages)
-            )
+            vb = self.batchers[victim]
+            grant = min(pages, self.fleet_config.preempt_pages)
+            if vb.controller is not None and not vb.controller.restore_pacing_ok(
+                grant, vb.host_page_bytes
+            ):
+                # Restore pacing (PR 15): the modeled restore debt —
+                # bytes preemption demoted that the one-page-per-
+                # iteration restore path has not repaid — is past its
+                # cap. Demoting more chains now just thrashes the
+                # tier (everything demoted is about to be restored),
+                # so classic 429 backpressure resumes until the debt
+                # drains. Controller-less fleets keep the PR-14
+                # behavior unchanged.
+                return False
+            vb.request_preempt(grant)
             _M_PREEMPTIONS.labels(replica=str(victim)).inc()
             with self._lock:
                 self._preempt_requests[victim] += 1
@@ -694,6 +721,16 @@ class FleetBackend(_backend_base.Backend):
 
     def health(self) -> dict:
         return self.replicas.heartbeat()
+
+    def request_cost(self, prompt: str, max_new_tokens: int) -> float:
+        """Modeled bytes for the gateway's cost-budget admission
+        (PR 15) — replica 0's pricing: the fleet is homogeneous in
+        config terms (one shared ContinuousConfig), so any replica's
+        modeled_request_cost is THE fleet price."""
+        b = self.replicas.batchers[0]
+        return b.modeled_request_cost(
+            len(self.replicas.tokenizer.encode(prompt)), max_new_tokens
+        )
 
     def preempt_for_admission(self) -> bool:
         return self.replicas.preempt_for_admission()

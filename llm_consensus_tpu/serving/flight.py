@@ -12,7 +12,9 @@ the answer's substrate:
 - :class:`FlightRecorder` — a bounded, evict-oldest ring of typed
   scheduler events (program dispatch/fetch windows, admissions/sheds,
   chunk scheduling, spec flips and catch-up replays, stream-plan donor
-  changes, demote/restore, pipeline flushes, CoW copies), each stamped
+  changes, demote/restore, pipeline flushes, CoW copies, and — PR 15 —
+  ``autotune`` knob decisions from the adaptive controller, recorded
+  on value changes), each stamped
   with monotonic time and the PR-5 trace id. Evictions are counted and
   mirrored into ``gateway_flight_dropped_total`` so a truncated export
   is detectable. Recording is a bool check when disabled and one

@@ -147,7 +147,9 @@ def verdict(bench: list[dict], threshold: float) -> dict:
         }
     latest = measured[-1]
     # Same-unit comparison only: the serving A/B legs (PR 12's
-    # --serve-decode-rounds and friends) emit "tokens/sec" payloads a
+    # --serve-decode-rounds and friends, PR 15's --serve-adaptive —
+    # every such leg MUST tag its payload with "unit") emit
+    # "tokens/sec" payloads a
     # driver may commit as a round artifact next to the headline
     # "tokens/sec/chip" rows — ratioing across units would fire (or
     # mask) regressions that never happened. A unit CHANGE therefore

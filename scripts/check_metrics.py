@@ -38,6 +38,7 @@ SCANNED = (
     "llm_consensus_tpu/serving/offload.py",
     "llm_consensus_tpu/serving/flight.py",
     "llm_consensus_tpu/serving/fleet.py",
+    "llm_consensus_tpu/serving/control.py",
     "llm_consensus_tpu/server/gateway.py",
     "llm_consensus_tpu/server/admission.py",
     "llm_consensus_tpu/consensus/coordinator.py",
