@@ -176,7 +176,19 @@ assert v.shape == (8, 8)
 """
 
 
-def _await_chip(budget_s: float, probe_timeout_s: float = 90.0) -> bool:
+#: Preflight retry backoff ladder (PR 16): start at 45 s; after two
+#: IDENTICAL consecutive failures (same phase + rc — the signature of a
+#: hard-down tunnel, not a flapping one) escalate to the next rung.
+#: Probing a dead remote every 45 s only burns the wait budget on
+#: subprocess startup; a changing failure mode resets to the bottom.
+_CHIP_BACKOFF_S = (45.0, 90.0, 180.0)
+
+
+def _await_chip(
+    budget_s: float,
+    probe_timeout_s: float = 90.0,
+    attempts: list | None = None,
+) -> bool:
     """Retry the preflight in SUBPROCESSES until the chip answers or the
     budget expires.
 
@@ -188,21 +200,42 @@ def _await_chip(budget_s: float, probe_timeout_s: float = 90.0) -> bool:
     Bridges short outages so a driver-invoked bench records a number
     instead of 0.0 (round-4's official record); budget via
     BENCH_CHIP_WAIT_S, default 600 s — a multi-hour outage still fails.
+
+    ``attempts`` (PR 16): pass a list to collect one structured record
+    per probe — ``{"phase": "probe"|"timeout", "rc": int|None,
+    "elapsed": s}`` — so the CHIP UNREACHABLE artifact carries the
+    failure history instead of burying it in stderr. Two identical
+    consecutive failures escalate the sleep up ``_CHIP_BACKOFF_S``.
     """
     import subprocess
 
     deadline = time.time() + budget_s
     attempt = 0
+    last_sig = None
+    same_sig = 0
+    rung = 0
     while True:
         attempt += 1
+        t0 = time.time()
+        sig = None
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
                 timeout=probe_timeout_s,
                 capture_output=True,
             )
+            elapsed = time.time() - t0
             if r.returncode == 0:
+                if attempts is not None:
+                    attempts.append(
+                        {
+                            "phase": "probe",
+                            "rc": 0,
+                            "elapsed": round(elapsed, 3),
+                        }
+                    )
                 return True
+            sig = ("probe", r.returncode)
             err = (r.stderr or b"").decode(errors="replace").strip()
             print(
                 f"[bench] chip probe attempt {attempt} rc={r.returncode}"
@@ -210,14 +243,32 @@ def _await_chip(budget_s: float, probe_timeout_s: float = 90.0) -> bool:
                 file=sys.stderr,
             )
         except subprocess.TimeoutExpired:
+            elapsed = time.time() - t0
+            sig = ("timeout", None)
             print(
                 f"[bench] chip probe attempt {attempt} timed out "
                 f"({probe_timeout_s:.0f}s)",
                 file=sys.stderr,
             )
+        if attempts is not None:
+            attempts.append(
+                {
+                    "phase": sig[0],
+                    "rc": sig[1],
+                    "elapsed": round(elapsed, 3),
+                }
+            )
         if time.time() >= deadline:
             return False
-        time.sleep(45.0)
+        if sig == last_sig:
+            same_sig += 1
+        else:
+            last_sig, same_sig = sig, 1
+            rung = 0
+        if same_sig >= 2 and rung < len(_CHIP_BACKOFF_S) - 1:
+            rung += 1
+            same_sig = 0
+        time.sleep(_CHIP_BACKOFF_S[rung])
 
 
 def _chip_responsive(timeout_s: float = 180.0) -> bool:
@@ -394,6 +445,20 @@ def main() -> int:
         default=0,
         help="--serve-replicas overload sub-leg storm size "
         "(concurrent gateway requests; 0 = 2x --serve-requests)",
+    )
+    p.add_argument(
+        "--serve-disagg",
+        action="store_true",
+        help="disaggregated prefill/decode A/B leg (PR 16): the PR-8 "
+        "mixed panel burst through a 2-replica fleet with roles "
+        "('prefill','decode') whose shared page store is a REMOTE "
+        "page-store server (localhost subprocess) vs a mixed-role "
+        "control — gates per-pair byte-identical text, >= 1 "
+        "cross-process chain handoff with ZERO re-prefilled header "
+        "pages on the decode side, then kills the store server and "
+        "drives a burst through one gateway gating degrade-to-"
+        "recompute (no 429s, /readyz stays ready, remote-store "
+        "errors counted)",
     )
     p.add_argument(
         "--serve-decode-pipeline",
@@ -663,8 +728,10 @@ def main() -> int:
             file=sys.stderr,
         )
         wait_budget = 600.0
+    preflight_attempts: list = []
     if not args.cpu and not (
-        _await_chip(wait_budget) and _chip_responsive(probe_timeout)
+        _await_chip(wait_budget, attempts=preflight_attempts)
+        and _chip_responsive(probe_timeout)
     ):
         # The tunneled chip can go unreachable for hours (observed
         # mid-round-4); a bench that hangs forever is worse than an
@@ -683,6 +750,13 @@ def main() -> int:
                 # Machine-readable: a no-data round, NOT a 0-tok/s
                 # measurement (bench_history treats it as such).
                 "status": "chip-unreachable",
+                # Structured per-attempt preflight report (PR 16):
+                # phase ("probe" subprocess exit / "timeout"), rc,
+                # elapsed seconds — the failure history a postmortem
+                # needs without scraping stderr. An empty list means
+                # the SUBPROCESS probes passed and the in-process
+                # preflight was what failed.
+                "preflight_attempts": preflight_attempts,
             },
             args.out,
         )
@@ -784,6 +858,8 @@ def main() -> int:
         return _bench_serving_flight_overhead(args, cfg, params)
     if args.serve_replicas:
         return _bench_serving_replicas(args, cfg, params)
+    if args.serve_disagg:
+        return _bench_serving_disagg(args, cfg, params)
     if args.serve_offload:
         return _bench_serving_offload(args, cfg, params)
     if args.serve_prefix_attention:
@@ -3189,6 +3265,291 @@ def _bench_serving_replicas(args, cfg, params) -> int:
         print(
             f"[bench] storm never exercised preemption (preempts "
             f"{preempts}, restored {restored}) — sizing regression",
+            file=sys.stderr,
+        )
+    return 0 if status == "ok" else 1
+
+
+def _bench_serving_disagg(args, cfg, params) -> int:
+    """Disaggregated prefill/decode A/B (PR 16): role-split fleet over
+    a REMOTE page store vs a mixed-role control, then a degraded
+    (killed-store) burst through one gateway.
+
+    Leg A — the PR-8 mixed panel burst (half the requests share one
+    multi-page header, half unique from byte 0) served through a
+    2-replica fleet with roles ("prefill", "decode") whose shared page
+    store is a remote page-store SERVER on localhost (a subprocess of
+    ``python -m llm_consensus_tpu.serving.remote_store``): the first
+    mate of the shared header triggers a warm-up on the prefill
+    replica whose chain crosses the process boundary through the
+    store, and the decode replica restores it at admission. Control:
+    the same burst through a mixed-role fleet with an in-process
+    store. Gates: per-pair byte-identical text (the PR-4 restore
+    contract across processes), >= 1 completed chain handoff, ZERO
+    re-prefilled header pages on the decode side (every shared-header
+    request's header pages arrive shared or restored).
+
+    Leg B — degrade: the store server is KILLED, then a burst runs
+    through a gateway over the (now storeless) disagg fleet. Gates:
+    every request completes with text (no 429s, nothing lost),
+    ``/readyz`` stays 200 (the worker loop never wedged on the dead
+    socket), and ``gateway_remote_store_errors_total`` counted the
+    outage.
+    """
+    import json as _json
+    import subprocess
+    import urllib.error
+    import urllib.request
+
+    from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+    from llm_consensus_tpu.server import metrics as _metrics
+    from llm_consensus_tpu.server.client import (
+        GatewayClient,
+        GatewayHTTPError,
+    )
+    from llm_consensus_tpu.server.gateway import (
+        Gateway,
+        GatewayConfig,
+        GatewayThread,
+    )
+    from llm_consensus_tpu.serving.continuous import ContinuousConfig
+    from llm_consensus_tpu.serving.fleet import (
+        FleetBackend,
+        FleetConfig,
+        ReplicaSet,
+    )
+    from llm_consensus_tpu.serving.remote_store import RemotePageStore
+
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    header = f"Disagg header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    n = args.serve_requests
+    uniq_pad = "distinct traffic padding " * (-(-header_target // 25))
+    prompts = [
+        header + f"Q{i}: propose for item {i * 37 % 101}"
+        for i in range(n // 2)
+    ] + [f"{i} unique {salt}: " + uniq_pad for i in range(n - n // 2)]
+    longest = max(len(p) for p in prompts) + 1
+    buckets = [64]
+    while buckets[-1] < longest:
+        buckets.append(buckets[-1] * 2)
+    pages_per_seq = _serve_pages_per_seq(
+        buckets[-1], args.new_tokens, args.serve_chunk, pg
+    )
+    host_bytes = args.serve_host_cache_mb << 20
+    serve_config = ContinuousConfig(
+        max_slots=args.serve_slots,
+        page_size=pg,
+        # Pool sized ABOVE the burst working set: leg A isolates the
+        # role split + transport, so eviction pressure stays out.
+        n_pages=1 + args.serve_slots * pages_per_seq * 2,
+        pages_per_seq=pages_per_seq,
+        max_new_tokens=args.new_tokens,
+        seq_buckets=tuple(buckets),
+        steps_per_sync=args.serve_chunk,
+        prefill_chunk=args.serve_prefill_chunk or 64,
+        share_prefix=True,
+        host_cache_bytes=host_bytes,
+    )
+
+    # The remote page-store server: a real second process on localhost.
+    server = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "llm_consensus_tpu.serving.remote_store",
+            "--budget-mb",
+            str(args.serve_host_cache_mb),
+            "--port",
+            "0",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    line = ""
+    try:
+        line = server.stdout.readline()
+        endpoint = _json.loads(line)["endpoint"]
+    except Exception:
+        server.kill()
+        print(
+            f"[bench] remote store server failed to start: {line!r}",
+            file=sys.stderr,
+        )
+        return 2
+    print(f"[bench] remote page store at {endpoint}", file=sys.stderr)
+
+    def warm(fleet):
+        futs = [
+            fleet.submit_to(
+                i, f"warmup {salt} r{i} " + "ctx " * (header_target // 5),
+                max_new_tokens=args.new_tokens,
+            )
+            for i in range(2)
+        ]
+        for f in futs:
+            f.result(timeout=600)
+
+    def run(role, host_store=None):
+        fleet = ReplicaSet(
+            cfg,
+            params,
+            config=serve_config,
+            fleet=FleetConfig(replicas=2, role=role, policy="prefix"),
+            host_store=host_store,
+        )
+        try:
+            warm(fleet)
+            t0 = time.perf_counter()
+            futs = [
+                fleet.submit(
+                    p, max_new_tokens=args.new_tokens, temperature=0.0
+                )
+                for p in prompts
+            ]
+            results = [f.result(timeout=600) for f in futs]
+            wall = time.perf_counter() - t0
+            toks = sum(r.num_tokens for r in results)
+            stats = fleet.stats()
+        finally:
+            if host_store is None:
+                fleet.close()
+            # The disagg fleet is reused by the degrade leg (leg B).
+        return fleet, results, toks / wall, stats
+
+    # Full header pages every shared-header request must receive via
+    # share/restore (the fleets run the default ByteTokenizer).
+    header_pages = len(ByteTokenizer().encode(header)) // pg
+
+    store = RemotePageStore(endpoint)
+    fleet, res_dis, tps_dis, s_dis = run(("prefill", "decode"), store)
+    _, res_mix, tps_mix, s_mix = run("mixed")
+    texts_dis = [r.text for r in res_dis]
+    texts_mix = [r.text for r in res_mix]
+    text_equal = texts_dis == texts_mix
+    handoffs = s_dis.get("role_handoffs", 0)
+    # Decode-side header provenance: every shared-header request's
+    # header pages must have arrived SHARED (CoW off a resident mate)
+    # or RESTORED (from the remote store) — zero re-prefilled.
+    recomputed = 0
+    restored_hdr = 0
+    for r in res_dis[: n // 2]:
+        t = r.timing or {}
+        got = t.get("header_pages_shared", 0) + t.get(
+            "header_pages_restored", 0
+        )
+        recomputed += max(0, header_pages - got)
+        restored_hdr += t.get("header_pages_restored", 0)
+
+    # -- leg B: kill the store; serving must degrade, not wedge ---------
+    def _reg_sum(prefix):
+        return sum(
+            v
+            for kk, v in _metrics.REGISTRY.snapshot().items()
+            if kk.startswith(prefix)
+        )
+
+    err_before = _reg_sum("gateway_remote_store_errors_total")
+    server.kill()
+    server.wait(timeout=30)
+    backend = FleetBackend(fleet)
+    gw = GatewayThread(Gateway(backend, config=GatewayConfig(port=0))).start()
+    errors: list[str] = []
+
+    def degrade_call(client, prompt):
+        try:
+            r = client.generate(
+                prompt, max_new_tokens=args.new_tokens, temperature=0.0
+            )
+            if not isinstance(r.get("text"), str):
+                errors.append(f"no text: {r}")
+        except GatewayHTTPError as e:
+            errors.append(f"HTTP {e.status}")
+        except Exception as e:  # noqa: BLE001 - counted, not raised
+            errors.append(repr(e))
+
+    import threading as _threading
+
+    try:
+        client = GatewayClient("127.0.0.1", gw.port, timeout=600.0)
+        h2 = f"Degrade header {salt}: " + "shared context " * (
+            -(-header_target // 15)
+        )
+        burst = [h2 + f"D{i}: degraded" for i in range(max(2, n // 2))]
+        threads = [
+            _threading.Thread(target=degrade_call, args=(client, p))
+            for p in burst
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gw.port}/readyz", timeout=30
+        ) as resp:
+            ready_status = resp.status
+    except urllib.error.HTTPError as e:
+        ready_status = e.code
+    finally:
+        gw.drain()
+        fleet.close()
+        store.close()
+        if server.poll() is None:
+            server.kill()
+    err_after = _reg_sum("gateway_remote_store_errors_total")
+    store_errors = err_after - err_before
+    e429 = sum(1 for e in errors if e == "HTTP 429")
+    lost = len(errors)
+
+    gate_handoff = handoffs >= 1 and recomputed == 0 and restored_hdr >= 1
+    gate_degrade = (
+        lost == 0 and e429 == 0 and ready_status == 200 and store_errors > 0
+    )
+    status = (
+        "ok" if (text_equal and gate_handoff and gate_degrade) else "failed"
+    )
+    _emit(
+        {
+            "metric": f"serving tok/s, disaggregated prefill/decode "
+            f"({cfg.name}, roles prefill+decode over remote store, "
+            f"{n} mixed reqs, slots={args.serve_slots}/replica, "
+            f"decode {args.new_tokens} @ ~{header_target} header, "
+            f"handoffs {handoffs}, header pages {header_pages}/req: "
+            f"{restored_hdr} restored / {recomputed} re-prefilled on "
+            f"decode side, mixed-role control {tps_mix:.0f} tok/s, "
+            f"degrade burst {len(burst)} reqs: 429s {e429}, lost "
+            f"{lost}, readyz {ready_status}, store errors "
+            f"{store_errors}, text unchanged={text_equal})",
+            "value": round(tps_dis, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(tps_dis / max(tps_mix, 1e-9), 4),
+            "status": status,
+        },
+        args.out,
+    )
+    if not text_equal:
+        print(
+            "[bench] GENERATED TEXT DIVERGED between the disaggregated "
+            "fleet and the mixed-role control — the cross-process "
+            "restore contract is broken",
+            file=sys.stderr,
+        )
+    if not gate_handoff:
+        print(
+            f"[bench] handoff gate failed: handoffs {handoffs}, "
+            f"{recomputed} header pages re-prefilled on the decode "
+            f"side, {restored_hdr} restored",
+            file=sys.stderr,
+        )
+    if not gate_degrade:
+        print(
+            f"[bench] degrade gate failed: {e429} x 429, {lost} lost "
+            f"({errors[:5]}), readyz {ready_status}, store errors "
+            f"{store_errors}",
             file=sys.stderr,
         )
     return 0 if status == "ok" else 1

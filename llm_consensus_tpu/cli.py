@@ -201,14 +201,30 @@ def _build_backend(args):
                 ReplicaSet,
             )
 
+            role = args.role
+            if "," in role:
+                role = tuple(r.strip() for r in role.split(","))
+            host_store = None
+            if args.host_store:
+                # Remote page-store tier (PR 16): the fleet's shared
+                # host tier lives in another process; --host-cache-mb
+                # still gates tier ENGAGEMENT (the budget itself is
+                # the server's).
+                from llm_consensus_tpu.serving.remote_store import (
+                    RemotePageStore,
+                )
+
+                host_store = RemotePageStore(args.host_store)
             return FleetBackend(
                 ReplicaSet(
                     cfg,
                     params,
                     tokenizer=load_tokenizer(args.tokenizer),
                     config=serve_config,
+                    host_store=host_store,
                     fleet=FleetConfig(
                         replicas=args.replicas,
+                        role=role,
                         # Keep the router's wedged-replica threshold in
                         # lockstep with the gateway's /readyz one: two
                         # independent defaults would let /readyz report
@@ -225,6 +241,13 @@ def _build_backend(args):
                     control=control,
                 )
             )
+        single_kw = {}
+        if args.host_store:
+            from llm_consensus_tpu.serving.remote_store import (
+                RemotePageStore,
+            )
+
+            single_kw["host_store"] = RemotePageStore(args.host_store)
         batcher = ContinuousBatcher(
             cfg,
             params,
@@ -235,6 +258,7 @@ def _build_backend(args):
             controller=(
                 AdaptiveController(control) if control is not None else None
             ),
+            **single_kw,
         )
         return ContinuousBackend(batcher)
     engine = InferenceEngine(
@@ -277,6 +301,28 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         "the shared host tier (--host-cache-mb, fleet-wide budget) "
         "instead of shedding 429s. 1 = a single batcher (the classic "
         "path)",
+    )
+    p.add_argument(
+        "--role",
+        default="mixed",
+        help="continuous backend with --replicas > 1: replica roles "
+        "(PR 16) — 'mixed' (default, uniform fleet), or a comma list "
+        "naming each replica's role, e.g. 'prefill,decode': prefill "
+        "replicas run admission + chunked prefill only (spec and "
+        "R-round windows off) and hand finished chains through the "
+        "fleet page store; decode replicas restore them and stream "
+        "tokens. At least one replica must be decode-capable",
+    )
+    p.add_argument(
+        "--host-store",
+        default=None,
+        metavar="ENDPOINT",
+        help="continuous backend: serve the host KV tier from a REMOTE "
+        "page-store server (PR 16) instead of an in-process one — "
+        "'tcp://host:port' or 'uds:///path' of a running "
+        "`python -m llm_consensus_tpu.serving.remote_store`. Requires "
+        "--host-cache-mb > 0 (the tier must be engaged); store "
+        "outages degrade to local recompute, never wedge serving",
     )
     p.add_argument(
         "--prefill-chunk",
@@ -684,6 +730,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="GET /readyz returns 503 when the backend serving loop's "
         "heartbeat is older than this (wedged loop)",
     )
+    p.add_argument(
+        "--peer",
+        action="append",
+        default=None,
+        metavar="URL",
+        help="cross-host peer tier (PR 16, repeatable): run this "
+        "gateway as a routing FRONT over peer gateways at these base "
+        "URLs ('http://host:port') — each /v1/* request is forwarded "
+        "to the peer whose GET /debug/chains probe shows the longest "
+        "resident chain for its prompt (move the query, not the "
+        "cache). The local backend still serves /healthz, /metrics "
+        "and debug routes; use --backend fake for a pure front",
+    )
     return p
 
 
@@ -736,6 +795,7 @@ def _run_serve(argv: list[str]) -> int:
             consensus_seed=args.seed,
             ready_stall_s=args.ready_stall_s,
             profile_dir=args.profile_dir,
+            peers=tuple(args.peer or ()),
         ),
     )
 

@@ -168,6 +168,14 @@ class AdmissionController:
         # event loop inside submit) and is expected to become False
         # once nothing is left to preempt — that, not the queue bound,
         # is then the shed condition. None (default) = classic shed.
+        # CHEAPNESS CONTRACT with remote stores (PR 16): the fleet's
+        # hook reads the page store's headroom to decide whether
+        # demotion can still land pages. A RemotePageStore serves that
+        # read from its last piggybacked stats snapshot — NEVER a
+        # network round-trip — precisely because this call sits on the
+        # event loop at peak overload. A store outage therefore reads
+        # as zero headroom (hook returns False) and overload degrades
+        # to the classic 429 shed, not a wedged submit path.
         self.overflow_hook: Callable[[], bool] | None = None
         self._work = asyncio.Event()
         self._idle = asyncio.Event()

@@ -41,6 +41,24 @@ Routes:
   returns one — or every member, for a trace several generations ran
   under (a consensus panel fan-out). The same summary rides each
   ``/v1/generate`` response as ``meta`` when the backend records one.
+- ``GET /debug/chains`` — chain-residency probe (PR 16):
+  ``?prompt=<text>`` (tokenized by the backend) or ``?ids=1,2,3``
+  returns the backend's ``prefix_probe`` — how many leading tokens
+  are registry-resident (``registry_tokens``) vs restorable from the
+  host tier (``host_tokens``). This is the wire form of the
+  PrefixRouter's affinity question, and what a PEER front gateway
+  asks before routing.
+
+Cross-host peer tier (PR 16): ``GatewayConfig(peers=(...))`` turns
+this gateway into a ROUTING FRONT — ``/v1/*`` requests are not served
+locally but forwarded to the peer gateway whose ``/debug/chains``
+probe shows the longest resident chain for the prompt (ties and cold
+chains go to the first reachable peer: "move the query, not the
+cache" across hosts). The probe + forward run in the default executor
+(urllib blocks); the peer's response body/status relay verbatim, with
+this front's ``X-Trace-Id`` attached so one trace id follows the
+request across hosts. An unreachable peer is skipped; all peers
+unreachable => 502.
 
 Status mapping: 429 + ``Retry-After`` on shed, 503 + ``Retry-After``
 while draining, 504 on deadline expiry, 502 on backend failure, 400 on
@@ -139,6 +157,17 @@ class GatewayConfig:
         # ``jax.profiler.trace(profile_dir)`` (one at a time; TensorBoard
         # format, aligned with the request's host spans). None = off.
         profile_dir: str | None = None,
+        # Cross-host peer tier (PR 16): base URLs of downstream peer
+        # gateways ("http://host:port"). Non-empty => this gateway is a
+        # routing FRONT: /v1/* is forwarded to the peer whose
+        # /debug/chains probe shows the longest resident chain.
+        peers: tuple = (),
+        # Budget for one forwarded /v1/* request (generation time
+        # included — size like a client timeout, not an RPC timeout).
+        peer_timeout_s: float = 120.0,
+        # Budget for one /debug/chains residency probe; a peer that
+        # cannot answer this quickly is skipped for this request.
+        peer_probe_timeout_s: float = 2.0,
     ):
         self.host = host
         self.port = port
@@ -150,6 +179,9 @@ class GatewayConfig:
         self.consensus_seed = consensus_seed
         self.ready_stall_s = ready_stall_s
         self.profile_dir = profile_dir
+        self.peers = tuple(p.rstrip("/") for p in peers)
+        self.peer_timeout_s = peer_timeout_s
+        self.peer_probe_timeout_s = peer_probe_timeout_s
 
 
 class Gateway:
@@ -429,6 +461,9 @@ class Gateway:
         if path == "/debug/requests" and method == "GET":
             await self._handle_requests(rawq, writer)
             return
+        if path == "/debug/chains" and method == "GET":
+            await self._handle_chains(rawq, writer)
+            return
         if path == "/metrics" and method == "GET":
             text = self.registry.render().encode()
             await self._respond_raw(
@@ -450,6 +485,9 @@ class Gateway:
             except ValueError as e:
                 await self._respond_json(writer, 400, {"error": f"bad JSON: {e}"})
                 self._count(path, 400)
+                return
+            if self.config.peers:
+                await self._handle_peer_forward(path, payload, body, writer)
                 return
             if path == "/v1/generate":
                 await self._handle_generate(payload, headers, writer)
@@ -593,6 +631,171 @@ class Gateway:
             },
         )
         self._count("/debug/requests", 200)
+
+    async def _handle_chains(self, rawq: str, writer) -> None:
+        """``GET /debug/chains``: chain-residency probe (PR 16).
+        ``?prompt=<text>`` (backend-tokenized) or ``?ids=1,2,3``
+        answers the backend's ``prefix_probe`` — registry-resident vs
+        host-restorable leading tokens. The probe itself takes the
+        batcher lock, so it runs in the executor, never on the loop."""
+        from urllib.parse import parse_qs
+
+        probe = getattr(self.backend, "prefix_probe", None)
+        if not callable(probe):
+            await self._respond_json(
+                writer, 404, {"error": "backend has no prefix probe"}
+            )
+            self._count("/debug/chains", 404)
+            return
+        q = parse_qs(rawq)
+        raw_ids = (q.get("ids") or [None])[0]
+        prompt = (q.get("prompt") or [None])[0]
+        loop = asyncio.get_running_loop()
+        try:
+            if raw_ids:
+                ids = [int(x) for x in raw_ids.split(",") if x.strip()]
+            elif prompt:
+                tok = getattr(self.backend, "tokenizer", None)
+                if tok is None:
+                    await self._respond_json(
+                        writer,
+                        404,
+                        {"error": "backend has no tokenizer; use ?ids="},
+                    )
+                    self._count("/debug/chains", 404)
+                    return
+                # HF tokenizers can be slow on long prompts: executor.
+                ids = await loop.run_in_executor(None, tok.encode, prompt)
+            else:
+                raise ValueError("need ?prompt=<text> or ?ids=1,2,3")
+        except ValueError as e:
+            await self._respond_json(writer, 400, {"error": str(e)})
+            self._count("/debug/chains", 400)
+            return
+        doc = await loop.run_in_executor(None, probe, ids)
+        await self._respond_json(writer, 200, {"n_ids": len(ids), **doc})
+        self._count("/debug/chains", 200)
+
+    # -- cross-host peer tier (PR 16) -----------------------------------
+
+    def _probe_peer(self, peer: str, prompt: str) -> int:
+        """Blocking residency probe of one peer (executor only).
+        Returns the longest resident/restorable prefix in tokens, 0
+        for a cold (or probe-less) peer, -1 for an unreachable one."""
+        import urllib.parse
+        import urllib.request
+
+        url = (
+            f"{peer}/debug/chains?prompt="
+            f"{urllib.parse.quote(prompt, safe='')}"
+        )
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self.config.peer_probe_timeout_s
+            ) as r:
+                doc = json.loads(r.read())
+            return max(
+                int(doc.get("registry_tokens", 0)),
+                int(doc.get("host_tokens", 0)),
+            )
+        except Exception:  # noqa: BLE001 - any failure => skip peer
+            return -1
+
+    def _forward_peer(self, peer: str, path: str, body: bytes, tid):
+        """Blocking forward of one /v1/* body to ``peer`` (executor
+        only). Returns (status, body, content_type); raises only on
+        transport failure (no HTTP response at all)."""
+        import urllib.error
+        import urllib.request
+
+        headers = {"Content-Type": "application/json"}
+        if tid:
+            headers["X-Trace-Id"] = tid
+        req = urllib.request.Request(
+            f"{peer}{path}", data=body, headers=headers, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.config.peer_timeout_s
+            ) as r:
+                return (
+                    r.status,
+                    r.read(),
+                    r.headers.get("Content-Type", "application/json"),
+                )
+        except urllib.error.HTTPError as e:
+            # A peer's 4xx/5xx is a RESPONSE to relay, not a transport
+            # failure: the peer's shed/drain statuses must reach the
+            # client (its Retry-After semantics are the contract).
+            return (
+                e.code,
+                e.read(),
+                e.headers.get("Content-Type", "application/json"),
+            )
+
+    async def _handle_peer_forward(
+        self, path: str, payload: dict, body: bytes, writer
+    ) -> None:
+        """Front-gateway routing (PR 16): probe every peer's
+        ``/debug/chains`` for this prompt concurrently, forward the
+        request to the one with the longest resident chain (first
+        reachable on ties/cold), relay its response verbatim. All
+        blocking I/O runs in the executor; the loop never waits on a
+        socket."""
+        prompt = payload.get("prompt") or payload.get("question") or ""
+        trace = _tracing.trace_store().start(path, route=path)
+        tid = trace.trace_id if trace is not None else None
+        loop = asyncio.get_running_loop()
+        try:
+            if isinstance(prompt, str) and prompt:
+                scores = await asyncio.gather(
+                    *(
+                        loop.run_in_executor(None, self._probe_peer, p, prompt)
+                        for p in self.config.peers
+                    )
+                )
+            else:
+                # No prompt to probe with (bad body: let the peer 400
+                # it) — treat every peer as cold-but-reachable.
+                scores = [0] * len(self.config.peers)
+            ranked = [
+                (p, s)
+                for p, s in zip(self.config.peers, scores)
+                if s >= 0
+            ]
+            if not ranked and any(s < 0 for s in scores):
+                # Every probe failed — the probes may be down while
+                # serving still works (older peers): fall back to
+                # trying peers in order rather than 502ing outright.
+                ranked = [(p, 0) for p in self.config.peers]
+            peer = max(ranked, key=lambda ps: ps[1])[0] if ranked else None
+            if peer is None:
+                await self._respond_json(
+                    writer, 502, {"error": "no peers configured"}
+                )
+                self._count(path, 502)
+                return
+            try:
+                status, out, ctype = await loop.run_in_executor(
+                    None, self._forward_peer, peer, path, body, tid
+                )
+            except Exception as e:  # noqa: BLE001 - transport failure
+                log.warning("peer %s unreachable: %s", peer, e)
+                await self._respond_json(
+                    writer,
+                    502,
+                    {"error": f"peer {peer} unreachable", "trace_id": tid},
+                )
+                self._count(path, 502)
+                return
+            hdrs = {"X-Peer": peer}
+            if tid:
+                hdrs["X-Trace-Id"] = tid
+            await self._respond_raw(writer, status, out, ctype, hdrs)
+            self._count(path, status)
+        finally:
+            if trace is not None:
+                trace.finish()
 
     def _record_shed(self, route: str, trace) -> None:
         """Mirror an admission shed into the flight recorder (PR 10):

@@ -82,6 +82,10 @@ __all__ = [
     "REPLICA_PREFIX_HIT_RATE",
     "REPLICA_PREEMPTIONS",
     "REPLICA_SHARED_STORE_BYTES",
+    "REMOTE_STORE_BYTES",
+    "REMOTE_STORE_ERRORS",
+    "REMOTE_STORE_RTT",
+    "ROLE_HANDOFFS",
 ]
 
 # Seconds: spans ~1 ms .. 2 min, the TTFT / request-latency range of a
@@ -794,6 +798,57 @@ AUTOTUNE_DECISIONS = REGISTRY.counter(
 AUTOTUNE_VALUE = REGISTRY.gauge(
     "gateway_autotune_value",
     "Last effective knob value decided by the adaptive controller",
+)
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode serving (PR 16, serving/remote_store.py +
+# serving/disagg.py): the fleet-scoped host page store becomes a
+# length-prefixed TCP/UDS transport so the router/store seam spans
+# processes and hosts, and replicas specialize into prefill/decode
+# ROLES that hand finished chains through it. Like the autotune
+# families above, these are process-global, last-writer-wins across a
+# roled fleet — the per-ROLE split lives in the fleet stats()
+# ``per_replica`` list (each entry names its replica's ``role``), the
+# same PR-14/15 convention for per-replica program counts and autotune
+# mirrors.
+# ---------------------------------------------------------------------------
+
+#: Bytes resident in the AUTHORITATIVE store behind a RemotePageStore
+#: client, as of the client's last successful exchange (every response
+#: frame piggybacks the server store's counters, so reading this never
+#: costs a network round trip — the admission overflow hook reads
+#: headroom on the event loop).
+REMOTE_STORE_BYTES = REGISTRY.gauge(
+    "gateway_remote_store_bytes",
+    "Bytes resident in the remote host page store (last-exchange view)",
+)
+#: Remote page-store operations that failed (connect refused, peer
+#: disconnect mid-frame, client timeout against a slow peer). Every
+#: failure degrades to a local MISS — get None / touch False / put
+#: dropped — so the worker loop recomputes instead of wedging; a
+#: climbing rate with a flat restored-pages rate is a dead peer.
+REMOTE_STORE_ERRORS = REGISTRY.counter(
+    "gateway_remote_store_errors_total",
+    "Remote page-store operations that failed and degraded to a miss",
+)
+#: Wall-clock round-trip per successful remote store exchange (request
+#: frame out to response frame parsed). Page payloads ride put/get, so
+#: compare against gateway_kv_restore_seconds to see what the wire adds
+#: to a restore.
+REMOTE_STORE_RTT = REGISTRY.histogram(
+    "gateway_remote_store_rtt_seconds",
+    "Round-trip latency per successful remote page-store exchange",
+    buckets=LATENCY_BUCKETS,
+)
+#: Chains handed from a prefill-role replica to a decode-role replica
+#: through the (shared or remote) page store: the prefill replica ran
+#: admission + chunked prefill, exported the finished chain via the
+#: PR-14 export path, and a decode replica's admission restored it —
+#: zero header pages re-prefilled on the decode side.
+ROLE_HANDOFFS = REGISTRY.counter(
+    "gateway_role_handoffs_total",
+    "Prefill-to-decode chain handoffs through the fleet page store",
 )
 
 

@@ -2882,60 +2882,75 @@ class ContinuousBatcher:
         )
 
     def _restore_step(self) -> bool:
-        """Promote ONE host-tier page back into the device pool.
+        """Promote queued host-tier pages back into the device pool.
 
-        The restore counterpart of :meth:`_prefill_step`: at most one
-        page's ``device_put`` + install runs between decode steps, so
-        running slots pay a bounded, page-sized stall — and the
-        readiness flip afterwards releases every admission gated on
-        this page (the admitting slot's first chunk, plus any
-        same-prefix burst-mate that deduped against the in-flight
-        restore). Returns True when a page was restored.
+        The restore counterpart of :meth:`_prefill_step`: a bounded
+        BATCH of ``device_put`` + installs runs between decode steps,
+        so running slots pay a bounded stall — and each readiness flip
+        releases every admission gated on that page (the admitting
+        slot's first chunk, plus any same-prefix burst-mate that
+        deduped against the in-flight restore). The batch size comes
+        from :meth:`AdaptiveController.restore_batch` — the pipeline
+        flush below is paid ONCE per call, so a host-bound loop drains
+        more pages per flush while a saturated decode lane stays at
+        the historical one page per iteration (the controller-less
+        fallback). Returns True when at least one page was restored.
         """
         if not self._restores:
             return False
+        batch = (
+            self.controller.restore_batch()
+            if self.controller is not None
+            else 1
+        )
         # Stable-cache operation: drain in-flight decode programs
-        # before installing host content into a pool page.
+        # before installing host content into pool pages (once for the
+        # whole batch — the amortization restore_batch sizes).
         self._flush_pipeline()
-        node, planes, trace = self._restores.popleft()
-        t0 = time.perf_counter()
-        self.cache = self._jit_install_page(
-            self.cache,
-            jnp.int32(node.page),
-            jnp.asarray(planes[0]),
-            jnp.asarray(planes[1]),
-        )
-        if self.draft_cache is not None and len(planes) >= 4:
-            # Draft planes demoted alongside the target's (PR 9): the
-            # restored prefix keeps its draft context, so acceptance
-            # doesn't silently collapse after an eviction round trip.
-            self.draft_cache = self._jit_install_page(
-                self.draft_cache,
+        restored = 0
+        while self._restores and restored < batch:
+            node, planes, trace = self._restores.popleft()
+            t0 = time.perf_counter()
+            self.cache = self._jit_install_page(
+                self.cache,
                 jnp.int32(node.page),
-                jnp.asarray(planes[2]),
-                jnp.asarray(planes[3]),
+                jnp.asarray(planes[0]),
+                jnp.asarray(planes[1]),
             )
-        # The install must COMPLETE before readers are released (same
-        # contract as a prefill chunk's block) — and the histogram's
-        # point is the true host->device promotion latency.
-        jax.block_until_ready(self.cache.length)
-        dur = time.perf_counter() - t0
-        _M_RESTORE_SECONDS.observe(dur)
-        if trace is not None:
-            trace.add_span("kv_restore", t0, dur, page=int(node.page))
-        _flight.flight_recorder().record(
-            "restore",
-            t0,
-            dur,
-            trace_id=_tracing.trace_id_of(trace),
-            page=int(node.page),
-        )
-        node.ready = True
-        _M_OFF_RESTORED.inc()
-        if self.controller is not None:
-            self.controller.note_restore(self.host_page_bytes)
+            if self.draft_cache is not None and len(planes) >= 4:
+                # Draft planes demoted alongside the target's (PR 9):
+                # the restored prefix keeps its draft context, so
+                # acceptance doesn't silently collapse after an
+                # eviction round trip.
+                self.draft_cache = self._jit_install_page(
+                    self.draft_cache,
+                    jnp.int32(node.page),
+                    jnp.asarray(planes[2]),
+                    jnp.asarray(planes[3]),
+                )
+            # The install must COMPLETE before readers are released
+            # (same contract as a prefill chunk's block) — and the
+            # histogram's point is the true host->device promotion
+            # latency, observed per page.
+            jax.block_until_ready(self.cache.length)
+            dur = time.perf_counter() - t0
+            _M_RESTORE_SECONDS.observe(dur)
+            if trace is not None:
+                trace.add_span("kv_restore", t0, dur, page=int(node.page))
+            _flight.flight_recorder().record(
+                "restore",
+                t0,
+                dur,
+                trace_id=_tracing.trace_id_of(trace),
+                page=int(node.page),
+            )
+            node.ready = True
+            _M_OFF_RESTORED.inc()
+            if self.controller is not None:
+                self.controller.note_restore(self.host_page_bytes)
+            restored += 1
         with self._lock:
-            self._offload_restored += 1
+            self._offload_restored += restored
         return True
 
     def _count_program(
@@ -4422,6 +4437,17 @@ class ContinuousBackend(_backend_base.Backend):
     def health(self) -> dict:
         """Gateway readiness probe surface: the batcher heartbeat."""
         return self.batcher.heartbeat()
+
+    @property
+    def tokenizer(self):
+        """The batcher tokenizer — the gateway's ``/debug/chains``
+        handler encodes ``?prompt=`` probes with it (PR 16)."""
+        return self.batcher.tokenizer
+
+    def prefix_probe(self, ids) -> dict:
+        """``/debug/chains`` probe surface: how much of this prompt's
+        prefix chain is resident here (PR 16 peer routing)."""
+        return self.batcher.prefix_probe(ids)
 
     def request_cost(self, prompt: str, max_new_tokens: int) -> float:
         """Modeled bytes of one request's whole schedule — the

@@ -97,7 +97,7 @@ __all__ = [
 
 #: Knob names — the ``knob`` label of gateway_autotune_* and the
 #: stats() mirror keys.
-KNOBS = ("spec_k", "rounds", "chunk", "depth")
+KNOBS = ("spec_k", "rounds", "chunk", "depth", "restore_batch")
 
 #: Per-platform peak HBM bandwidth (GB/s, 1e9 bytes/s) for
 #: ``--hbm-gbps auto``: matched as a lowercase substring of
@@ -249,6 +249,19 @@ class ControlConfig:
     #: overhead signal is re-measured at lower depth.
     depth_probe_every: int = 64
     depth_probe_len: int = 16
+
+    # -- restore-batch sizing (host-tier promotion) ---------------------
+    tune_restore_batch: bool = True
+    #: Most pages one worker iteration may promote from the host tier
+    #: (each restore flushes the decode pipeline and blocks on the
+    #: installs). The controller moves the effective batch within
+    #: ``[1, restore_batch_max]`` from the SAME un-overlapped-overhead
+    #: EWMA chunk/depth steering reads: a host-bound loop takes the
+    #: full batch (the flush it amortizes was already stalling on the
+    #: host), a fully hidden loop takes 1 (bound the stall injected
+    #: into a saturated decode lane). Controller absent => 1, the
+    #: exact pre-PR-16 one-page-per-iteration behavior.
+    restore_batch_max: int = 8
 
     # -- restore pacing (fleet preempt hook) ----------------------------
     #: Cap on the modeled restore debt preemption may accumulate,
@@ -836,6 +849,32 @@ class AdaptiveController:
                 return self._probe_depth
             self._decide("depth", self._depth_eff)
             return self._depth_eff
+
+    # -- restore-batch sizing (host-tier promotion) ---------------------
+
+    def restore_batch(self) -> int:
+        """Pages ``_restore_step`` may promote THIS iteration, within
+        ``[1, restore_batch_max]`` — steered by the same un-overlapped
+        overhead EWMA as chunk/depth (see ControlConfig). Unknown
+        overhead (cold start) takes the full batch: before any decode
+        dispatch the loop has nothing to stall."""
+        cfg = self.config
+        cap = max(1, cfg.restore_batch_max)
+        if not cfg.tune_restore_batch or cap <= 1:
+            return cap
+        with self._lock:
+            ovh = self._ovh_ewma
+            if ovh is None or ovh > cfg.overhead_high_s:
+                value = cap
+            elif ovh <= cfg.overhead_low_s:
+                value = 1
+            else:
+                # Between the hysteresis edges: half the cap — the
+                # host is partly visible, so some amortization pays
+                # without a full-batch stall.
+                value = max(1, cap // 2)
+            self._decide("restore_batch", value)
+            return value
 
     # -- restore pacing (fleet preempt hook) ----------------------------
 

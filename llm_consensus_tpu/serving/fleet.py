@@ -52,10 +52,15 @@ places every request where its KV already lives:
   gateway's ``/readyz`` and is reported by index), and the router
   stops routing to stale/dead replicas while any healthy one remains.
 
-TPLA's disaggregated-inference framing motivates the role-aware
-replica abstraction: replicas are uniform here, but the router +
-shared-store transport is exactly the seam a prefill/decode role split
-(ROADMAP item 1, second half) plugs into.
+Role-specialized since PR 16 (:mod:`llm_consensus_tpu.serving.disagg`):
+``FleetConfig.role`` splits the fleet into prefill-heavy and
+decode-heavy replicas — prefill replicas warm cold chains and hand
+them through the shared store (the export path), decode replicas
+restore and stream; the router routes real requests to decode-capable
+replicas only. And the shared store itself may be REMOTE
+(:mod:`llm_consensus_tpu.serving.remote_store`): pass
+``ReplicaSet(host_store=RemotePageStore(...))`` and the same
+preempt/export/restore transport crosses process and host boundaries.
 
 Threading: ``submit``/``route`` run on caller threads (the gateway
 event loop, tests); probes take each batcher's admission lock
@@ -144,6 +149,19 @@ class FleetConfig:
     #: once the spill lands); bounded, and rebalances only fire at
     #: congestion moments. 0 = always fire-and-forget.
     rebalance_export_wait_s: float = 0.5
+    #: Replica role split (PR 16, serving/disagg.py): ``"mixed"``
+    #: (every replica runs both phases — the pre-PR-16 fleet),
+    #: ``"prefill"``/``"decode"`` fleet-wide, or a per-replica tuple
+    #: like ``("prefill", "decode")``. Prefill replicas warm cold
+    #: chains and export them through the shared store; the router
+    #: sends real requests to decode-capable replicas only.
+    role: str | tuple = "mixed"
+    #: Bound on a handoff's warm-prefill + export wait (covers the
+    #: prefill replica's first-compile on a cold fleet). Applied ONLY
+    #: off the asyncio event loop — on the gateway loop the handoff
+    #: completes on a daemon thread instead (the same rule as
+    #: rebalance_export_wait_s). 0 = always hand off asynchronously.
+    handoff_wait_s: float = 60.0
 
 
 class PrefixRouter:
@@ -165,10 +183,15 @@ class PrefixRouter:
         batchers: list[ContinuousBatcher],
         config: FleetConfig,
         page_size: int,
+        roles: tuple[str, ...] | None = None,
     ):
         self.batchers = batchers
         self.config = config
         self.page_size = page_size
+        #: Per-replica roles (PR 16): prefill-role replicas never take
+        #: real requests through route() — they serve handoff warm-ups
+        #: only (serving/disagg.py). None = every replica serves.
+        self.roles = roles
         self._rr = 0
         self._rr_lock = threading.Lock()
         # Pending-route hints: first prefix-page run -> (replica,
@@ -191,6 +214,18 @@ class PrefixRouter:
             if hb["alive"] and hb["last_tick_age_s"] <= self.config.ready_stall_s:
                 out.append(i)
         return out or list(range(len(self.batchers)))
+
+    def serving(self) -> list[int]:
+        """Healthy replicas eligible for REAL requests: with roles
+        active, prefill-only replicas drop out (they serve handoff
+        warm-ups through the coordinator, never routed traffic). Falls
+        back to every healthy replica when the filter empties — same
+        route-somewhere principle as :meth:`healthy`."""
+        healthy = self.healthy()
+        if self.roles is None:
+            return healthy
+        out = [i for i in healthy if self.roles[i] != "prefill"]
+        return out or healthy
 
     def _next_rr(self, candidates: list[int]) -> int:
         with self._rr_lock:
@@ -243,7 +278,7 @@ class PrefixRouter:
         :func:`prefix_chain_key` (the submit path fingerprints ONCE
         and threads it through; None recomputes)."""
         c = self.config
-        healthy = self.healthy()
+        healthy = self.serving()
         if c.policy == "random":
             # The control policy stays deliberately chain-blind (no
             # hints either) — the A/B isolates what affinity buys.
@@ -355,7 +390,14 @@ class ReplicaSet:
         meshes: list | None = None,
         draft: tuple[ModelConfig, dict] | None = None,
         control=None,
+        host_store=None,
     ):
+        from llm_consensus_tpu.serving.disagg import (
+            HandoffCoordinator,
+            resolve_roles,
+            role_config,
+        )
+
         self.cfg = cfg
         self.config = config or ContinuousConfig()
         self.fleet_config = fleet or FleetConfig()
@@ -375,8 +417,24 @@ class ReplicaSet:
             )
         replica_meshes = meshes if meshes is not None else [mesh] * k
         c = self.config
+        self.roles = resolve_roles(self.fleet_config.role, k)
+        tier_on = (
+            c.host_cache_bytes > 0 and c.share_prefix and c.prefill_chunk > 0
+        )
         self.store: HostPageStore | None = None
-        if c.host_cache_bytes > 0 and c.share_prefix and c.prefill_chunk > 0:
+        if host_store is not None:
+            # EXTERNAL store (PR 16): typically a RemotePageStore over
+            # the authoritative tier in another process — the same
+            # interface, so everything below (preempt, export,
+            # restore, stats) takes it transparently.
+            if not tier_on:
+                raise ValueError(
+                    "a shared host_store needs the offload tier "
+                    "engaged: host_cache_bytes > 0, share_prefix, "
+                    "prefill_chunk > 0"
+                )
+            self.store = host_store
+        elif tier_on:
             # ONE store, fleet-wide budget: any replica restores any
             # chain (store keys carry each replica's config/weights
             # scope, so a heterogeneous fleet can never cross-restore).
@@ -401,7 +459,12 @@ class ReplicaSet:
                 cfg,
                 params,
                 tokenizer=self.tokenizer,
-                config=c,
+                # Decode/mixed replicas share the fleet's live config
+                # instance; a prefill replica gets role_config's copy
+                # with the decode-phase machinery pinned off. None of
+                # the replaced fields enter the store-key scope, so
+                # roled replicas still restore each other's pages.
+                config=role_config(c, self.roles[i]),
                 mesh=replica_meshes[i],
                 draft=draft,
                 host_store=self.store,
@@ -415,8 +478,22 @@ class ReplicaSet:
                 scope = b._store_scope
             self.batchers.append(b)
         self.router = PrefixRouter(
-            self.batchers, self.fleet_config, c.page_size
+            self.batchers, self.fleet_config, c.page_size, roles=self.roles
         )
+        # Prefill→decode handoffs engage only when a prefill-role
+        # replica exists AND the page transport is live (a roled fleet
+        # without a store could never move the chain).
+        self.handoff: HandoffCoordinator | None = None
+        if "prefill" in self.roles:
+            if self.store is not None:
+                self.handoff = HandoffCoordinator(self)
+            else:
+                log.warning(
+                    "prefill-role replicas configured without a page "
+                    "transport (host_cache_bytes == 0 or sharing off): "
+                    "no chain can ever hand off — the prefill replicas "
+                    "will idle while decode replicas prefill everything"
+                )
         # stats() mirrors of the routed/preempt Prometheus counters
         # (lockstep tested).
         self._lock = threading.Lock()
@@ -447,6 +524,11 @@ class ReplicaSet:
         full_ids = self.tokenizer.encode(prompt)
         ids = full_ids[-self.config.seq_buckets[-1] :]
         chain = prefix_chain_key(ids, self.config.page_size)
+        if self.handoff is not None:
+            # Role split (PR 16): a cold chain warms on a prefill
+            # replica and lands in the shared store before (off-loop)
+            # or while (on the gateway loop) the real request decodes.
+            self.handoff.ensure_prefilled(prompt, ids, chain)
         idx, reason = self.router.route(ids, chain=chain)
         self._count_route(idx, reason, chain)
         return self.batchers[idx].submit(
@@ -582,6 +664,19 @@ class ReplicaSet:
 
     # -- observability / lifecycle --------------------------------------
 
+    def prefix_probe(self, ids) -> dict:
+        """The fleet's best resident-chain view for these token ids —
+        the max over every replica's read-only
+        :meth:`ContinuousBatcher.prefix_probe` (registry pages first,
+        host-tier extension breaks ties: the router's own comparison).
+        The ``/debug/chains`` probe surface a front gateway's
+        peer-routing reads (PR 16)."""
+        best = (0, 0)
+        for b in self.batchers:
+            p = b.prefix_probe(ids)
+            best = max(best, (p["registry_tokens"], p["host_tokens"]))
+        return {"registry_tokens": best[0], "host_tokens": best[1]}
+
     def heartbeat(self) -> dict:
         """Aggregate serving-loop liveness: ``alive`` only when EVERY
         replica's loop is alive (a degraded fleet must flip /readyz —
@@ -614,6 +709,11 @@ class ReplicaSet:
         ``_shared_store_bytes``), so a scrape following a stats pull
         is current."""
         per = [b.stats() for b in self.batchers]
+        for i, role in enumerate(self.roles):
+            # The per-ROLE split of the process-global (last-writer-
+            # wins) autotune families: each replica's stats carry its
+            # role, the PR-14/15 per-replica convention.
+            per[i]["role"] = role
         for i, b in enumerate(self.batchers):
             # The same accessors the route-time refresh uses — ONE
             # definition of each gauge's value (a second copy keyed on
@@ -632,6 +732,10 @@ class ReplicaSet:
         return {
             "replicas": len(self.batchers),
             "policy": self.fleet_config.policy,
+            "roles": list(self.roles),
+            "role_handoffs": (
+                self.handoff.handoffs if self.handoff is not None else 0
+            ),
             "per_replica": per,
             "routed": routed,
             "routed_total": sum(sum(r.values()) for r in routed),
@@ -721,6 +825,17 @@ class FleetBackend(_backend_base.Backend):
 
     def health(self) -> dict:
         return self.replicas.heartbeat()
+
+    @property
+    def tokenizer(self):
+        """The fleet tokenizer — the gateway's ``/debug/chains``
+        handler encodes ``?prompt=`` probes with it."""
+        return self.replicas.tokenizer
+
+    def prefix_probe(self, ids) -> dict:
+        """``/debug/chains`` probe surface: the fleet-wide best
+        resident-chain view (PR 16)."""
+        return self.replicas.prefix_probe(ids)
 
     def request_cost(self, prompt: str, max_new_tokens: int) -> float:
         """Modeled bytes for the gateway's cost-budget admission

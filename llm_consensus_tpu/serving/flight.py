@@ -12,9 +12,13 @@ the answer's substrate:
 - :class:`FlightRecorder` — a bounded, evict-oldest ring of typed
   scheduler events (program dispatch/fetch windows, admissions/sheds,
   chunk scheduling, spec flips and catch-up replays, stream-plan donor
-  changes, demote/restore, pipeline flushes, CoW copies, and — PR 15 —
-  ``autotune`` knob decisions from the adaptive controller, recorded
-  on value changes), each stamped
+  changes, demote/restore, pipeline flushes, CoW copies, PR 15's
+  ``autotune`` knob decisions from the adaptive controller (recorded
+  on value changes), and — PR 16 — ``handoff`` (a prefill→decode
+  chain handoff completed: source replica + chain pages) and
+  ``remote_store`` (the remote page store's circuit breaker flipped
+  ``state=down``/``up`` — one event per outage TRANSITION, not per
+  failed op, so a dead peer cannot flood the ring)), each stamped
   with monotonic time and the PR-5 trace id. Evictions are counted and
   mirrored into ``gateway_flight_dropped_total`` so a truncated export
   is detectable. Recording is a bool check when disabled and one

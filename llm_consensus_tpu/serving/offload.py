@@ -202,6 +202,24 @@ class HostPageStore:
             self.hits += 1
             return planes
 
+    def stats_snapshot(self) -> dict:
+        """Every counter plus occupancy, read under ONE lock hold — the
+        consistent view the remote page-store server piggybacks on each
+        response frame and the fleet stats() reads once per pull (N
+        separate property reads could interleave with a concurrent
+        demote and report hits > lookups)."""
+        with self._lock:
+            return {
+                "pages": len(self._entries),
+                "bytes_used": self._bytes,
+                "budget_bytes": self.budget_bytes,
+                "headroom_bytes": max(0, self.budget_bytes - self._bytes),
+                "demoted_pages": self.demoted_pages,
+                "dropped_pages": self.dropped_pages,
+                "lookups": self.lookups,
+                "hits": self.hits,
+            }
+
 
 def page_planes(cache, page: int) -> tuple[np.ndarray, np.ndarray]:
     """Fetch one page's (k, v) planes to host, verbatim dtype.

@@ -1,0 +1,511 @@
+"""Remote host page store: the fleet KV tier across processes (PR 16).
+
+PR 14 made :class:`~llm_consensus_tpu.serving.offload.HostPageStore`
+the fleet's page transport — thread-safe, chain-keyed, scoped by each
+batcher's config dims + weights fingerprint so heterogeneous replicas
+can never cross-restore. But it is in-memory, which confines the fleet
+to one process. This module lifts the SAME interface onto a socket:
+
+- :class:`PageStoreServer` wraps ONE authoritative ``HostPageStore``
+  behind a length-prefixed TCP or Unix-domain transport (one frame per
+  request/response; payload = op + key + raw plane bytes). There is no
+  negotiation in the protocol because none is needed: the PR-14
+  ``(scope, chain)`` keys already carry config dims and the weights
+  fingerprint, so a process whose scope differs simply never hits.
+- :class:`RemotePageStore` is a client implementing the full
+  ``HostPageStore`` surface (``put_counted`` / ``touch`` / ``get`` /
+  ``__contains__`` / ``headroom_bytes`` / the counters), so
+  ``ReplicaSet`` / ``ContinuousBatcher(host_store=)`` take a local
+  store or a remote one transparently — 4-plane target+draft entries
+  included (the store layer is plane-count agnostic).
+
+**Failure contract — degrade, never wedge.** Every client failure
+(connect refused, peer disconnect mid-``put``, a slow peer hitting the
+client timeout) degrades to a local MISS: ``get`` returns None,
+``touch``/``__contains__`` return False, ``put_counted`` reports the
+page dropped — so the worker loop recomputes via chunked prefill
+(always correct) instead of stalling. Each failure increments
+``gateway_remote_store_errors_total``, logs ONE warning per outage
+(not per op), records a ``remote_store`` flight event on the
+transition, and opens the circuit for ``retry_s`` seconds — ops during
+the open window miss immediately with no socket attempt, so a dead
+peer costs the worker loop nothing per iteration (heartbeat stays
+fresh; tested).
+
+**Cheap reads by piggyback.** Every server response frame carries the
+authoritative store's :meth:`stats_snapshot`, which the client caches;
+``headroom_bytes`` / ``bytes_used`` / ``len`` / the counters read the
+cache and NEVER touch the network — the admission overflow hook reads
+headroom on the asyncio event loop, where a blocking RTT would freeze
+the gateway under exactly the overload the hook exists to absorb.
+``gateway_remote_store_bytes`` mirrors the cached occupancy;
+``gateway_remote_store_rtt_seconds`` observes each successful
+exchange.
+
+Wire format: ``4-byte big-endian length || pickle payload``, with
+plane arrays serialized explicitly as ``(dtype_str, shape, bytes)``
+triples — keys + raw bytes, nothing else. Pickle is a FLEET-INTERNAL
+trust boundary (bind localhost/UDS, same deployment): the transport
+authenticates nothing, exactly like the in-process store it replaces.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+from llm_consensus_tpu.server.metrics import (
+    REMOTE_STORE_BYTES as _M_BYTES,
+)
+from llm_consensus_tpu.server.metrics import (
+    REMOTE_STORE_ERRORS as _M_ERRORS,
+)
+from llm_consensus_tpu.server.metrics import (
+    REMOTE_STORE_RTT as _M_RTT,
+)
+from llm_consensus_tpu.serving.offload import HostPageStore
+
+log = logging.getLogger(__name__)
+
+__all__ = ["PageStoreServer", "RemotePageStore", "parse_endpoint"]
+
+_LEN = struct.Struct(">I")
+#: Refuse frames past this (a corrupt length prefix must not allocate
+#: gigabytes): generous for any real page payload (a 1B-class bf16
+#: page is ~1.5 MiB; 4-plane int8+scales entries are smaller).
+_MAX_FRAME = 256 << 20
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"frame length {n} exceeds cap {_MAX_FRAME}")
+    return _recv_exact(sock, n)
+
+
+def _enc_planes(planes: Sequence[np.ndarray]) -> list:
+    """Planes -> ``(dtype, shape, bytes)`` triples (the raw-bytes half
+    of the wire format; plane COUNT rides along, so 2-plane bf16 and
+    4-plane target+draft / int8+scale entries all pass through).
+
+    Dtypes travel by NAME, not ``.str``: the extension dtypes the KV
+    pool actually uses (ml_dtypes bfloat16 et al.) stringify as opaque
+    void codes (``|V2``) under ``.str``, which would decode to planes
+    jax rejects at restore time."""
+    out = []
+    for p in planes:
+        a = np.ascontiguousarray(p)
+        out.append((a.dtype.name, a.shape, a.tobytes()))
+    return out
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Dtype from its wire name, resolving extension dtypes (bfloat16,
+    float8 variants) through ml_dtypes when numpy alone can't."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _dec_planes(enc: list) -> tuple:
+    return tuple(
+        np.frombuffer(raw, dtype=_np_dtype(dt)).reshape(shape)
+        for dt, shape, raw in enc
+    )
+
+
+def parse_endpoint(spec) -> tuple[str, object]:
+    """``"tcp://host:port"`` / ``"uds:///path"`` / ``(host, port)`` /
+    a bare filesystem path -> ``("tcp", (host, port))`` or
+    ``("uds", path)``."""
+    if isinstance(spec, tuple):
+        return "tcp", (spec[0], int(spec[1]))
+    s = str(spec)
+    if s.startswith("tcp://"):
+        host, _, port = s[len("tcp://"):].rpartition(":")
+        return "tcp", (host or "127.0.0.1", int(port))
+    if s.startswith("uds://"):
+        return "uds", s[len("uds://"):]
+    if "/" in s or not s:
+        return "uds", s
+    host, _, port = s.rpartition(":")
+    return "tcp", (host or "127.0.0.1", int(port))
+
+
+class PageStoreServer:
+    """Length-prefixed page-transport server over ONE authoritative
+    :class:`HostPageStore`.
+
+    One accept thread + one daemon thread per connection (a fleet has
+    a handful of clients, each holding one long-lived socket). All
+    mutation funnels through the wrapped store's own lock, so a local
+    in-process user and remote clients can share it.
+    """
+
+    def __init__(
+        self,
+        store: HostPageStore,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        path: str | None = None,
+    ):
+        self.store = store
+        self._path = path
+        if path is not None:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(path)
+            self.endpoint = f"uds://{path}"
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self.endpoint = "tcp://{}:{}".format(*self._sock.getsockname())
+        self._sock.listen(16)
+        self._closed = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+
+    def start(self) -> "PageStoreServer":
+        t = threading.Thread(
+            target=self._accept_loop, name="page-store-accept", daemon=True
+        )
+        self._accept_thread = t
+        t.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._serve_conn,
+                args=(conn,),
+                name="page-store-conn",
+                daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                try:
+                    req = pickle.loads(_recv_frame(conn))
+                    reply = self._handle(req)
+                except (ConnectionError, OSError, EOFError):
+                    return
+                except Exception as e:  # noqa: BLE001 - malformed op
+                    reply = ("err", repr(e), self.store.stats_snapshot())
+                try:
+                    _send_frame(conn, pickle.dumps(reply, protocol=4))
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, req: tuple) -> tuple:
+        op, args = req[0], req[1:]
+        store = self.store
+        if op == "put_counted":
+            key, enc = args
+            result = store.put_counted(key, _dec_planes(enc))
+        elif op == "touch":
+            result = store.touch(args[0])
+        elif op == "get":
+            planes = store.get(args[0])
+            result = None if planes is None else _enc_planes(planes)
+        elif op == "contains":
+            result = args[0] in store
+        elif op == "stats":
+            result = None
+        else:
+            raise ValueError(f"unknown op {op!r}")
+        return "ok", result, store.stats_snapshot()
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._path is not None:
+            import os
+
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+class RemotePageStore:
+    """Client half: the ``HostPageStore`` interface over a socket.
+
+    Drop-in for the places a fleet passes a store —
+    ``ReplicaSet(host_store=)`` / ``ContinuousBatcher(host_store=)`` —
+    with the degrade-to-miss failure contract described in the module
+    docstring. Construction NEVER raises on a dead server: the first
+    exchange fails, the circuit opens, and the batcher recomputes
+    until the peer answers.
+    """
+
+    def __init__(self, endpoint, *, timeout_s: float = 2.0, retry_s: float = 1.0):
+        self.kind, self.address = parse_endpoint(endpoint)
+        self.endpoint = (
+            f"{self.kind}://{self.address}"
+            if self.kind == "uds"
+            else "tcp://{}:{}".format(*self.address)
+        )
+        self.timeout_s = float(timeout_s)
+        self.retry_s = float(retry_s)
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._down_until = 0.0
+        self._warned_down = False
+        #: Local failure count (mirrors gateway_remote_store_errors_total
+        #: for this client; the Prometheus family is process-global).
+        self.errors = 0
+        # Last piggybacked authoritative-store snapshot: the cache
+        # behind every read property (no network on the read path).
+        self._stats: dict = {}
+        # Best-effort warm-up: populates the stats cache when the
+        # server is up; opens the circuit (no raise) when it is not.
+        self._call("stats")
+
+    # -- transport ------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self.kind == "uds":
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(self.timeout_s)
+        s.connect(self.address)
+        return s
+
+    def _fail(self, exc: Exception) -> None:
+        """One failure: count, open the circuit, warn on the DOWN
+        transition only (a dead peer must not log per worker-loop op),
+        and drop the socket so the next attempt reconnects."""
+        self.errors += 1
+        _M_ERRORS.inc()
+        self._down_until = time.monotonic() + self.retry_s
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if not self._warned_down:
+            self._warned_down = True
+            log.warning(
+                "remote page store %s unavailable (%r): degrading to "
+                "local miss/recompute until it answers",
+                self.endpoint,
+                exc,
+            )
+            self._flight("down", error=repr(exc))
+
+    def _flight(self, state: str, **extra) -> None:
+        # Lazy import mirrors control.py: consumers of this module may
+        # not want the flight module (and its deps) at import time.
+        try:
+            from llm_consensus_tpu.serving import flight as _flight
+
+            _flight.flight_recorder().record(
+                "remote_store",
+                time.perf_counter(),
+                endpoint=self.endpoint,
+                state=state,
+                **extra,
+            )
+        except Exception:  # noqa: BLE001 - telemetry must not fail ops
+            pass
+
+    def _call(self, op: str, *args):
+        """One request/response exchange. Returns the result, or None
+        after ANY failure (the degrade-to-miss contract; callers map
+        None to their own miss value). Never raises."""
+        with self._lock:
+            if time.monotonic() < self._down_until:
+                self.errors += 1
+                _M_ERRORS.inc()
+                return None
+            t0 = time.perf_counter()
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                payload = pickle.dumps((op, *args), protocol=4)
+                _send_frame(self._sock, payload)
+                status, result, stats = pickle.loads(_recv_frame(self._sock))
+            except (OSError, ConnectionError, EOFError, pickle.PickleError) as e:
+                self._fail(e)
+                return None
+            if status != "ok":
+                # The server rejected the op (malformed key): a miss,
+                # but the connection is healthy — no circuit.
+                self.errors += 1
+                _M_ERRORS.inc()
+                log.warning(
+                    "remote page store %s rejected %s: %s",
+                    self.endpoint,
+                    op,
+                    result,
+                )
+                return None
+            self._stats = stats
+            _M_RTT.observe(time.perf_counter() - t0)
+            _M_BYTES.set(stats.get("bytes_used", 0))
+            if self._warned_down:
+                self._warned_down = False
+                log.info("remote page store %s recovered", self.endpoint)
+                self._flight("up")
+            return (True, result)  # wrap: distinguish None-result hits
+
+    # -- HostPageStore surface ------------------------------------------
+
+    def put(self, key: tuple, planes: Sequence[np.ndarray]) -> bool:
+        resident, _, _ = self.put_counted(key, planes)
+        return resident
+
+    def put_counted(
+        self, key: tuple, planes: Sequence[np.ndarray]
+    ) -> tuple[bool, int, int]:
+        hit = self._call("put_counted", key, _enc_planes(planes))
+        if hit is None:
+            # The page never left the process: not resident, not
+            # demoted anywhere — report it dropped so the caller's
+            # accounting reflects a real loss, not a silent no-op.
+            return False, 0, 1
+        return tuple(hit[1])
+
+    def touch(self, key: tuple) -> bool:
+        hit = self._call("touch", key)
+        return bool(hit[1]) if hit is not None else False
+
+    def get(self, key: tuple):
+        hit = self._call("get", key)
+        if hit is None or hit[1] is None:
+            return None
+        return _dec_planes(hit[1])
+
+    def __contains__(self, key: tuple) -> bool:
+        hit = self._call("contains", key)
+        return bool(hit[1]) if hit is not None else False
+
+    def refresh_stats(self) -> dict:
+        """One explicit stats exchange (tests + periodic refresh);
+        returns the cached snapshot either way."""
+        self._call("stats")
+        return dict(self._stats)
+
+    # Read properties serve the piggybacked cache — NEVER the network
+    # (the admission overflow hook reads headroom on the event loop).
+
+    def __len__(self) -> int:
+        return int(self._stats.get("pages", 0))
+
+    @property
+    def bytes_used(self) -> int:
+        return int(self._stats.get("bytes_used", 0))
+
+    @property
+    def budget_bytes(self) -> int:
+        return int(self._stats.get("budget_bytes", 0))
+
+    @property
+    def headroom_bytes(self) -> int:
+        return int(self._stats.get("headroom_bytes", 0))
+
+    @property
+    def demoted_pages(self) -> int:
+        return int(self._stats.get("demoted_pages", 0))
+
+    @property
+    def dropped_pages(self) -> int:
+        return int(self._stats.get("dropped_pages", 0))
+
+    @property
+    def lookups(self) -> int:
+        return int(self._stats.get("lookups", 0))
+
+    @property
+    def hits(self) -> int:
+        return int(self._stats.get("hits", 0))
+
+    def stats_snapshot(self) -> dict:
+        return dict(self._stats)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone authoritative store process:
+    ``python -m llm_consensus_tpu.serving.remote_store --budget-mb 256``
+    prints one JSON line ``{"endpoint": ...}`` then serves until
+    SIGTERM/SIGINT — the cross-process half of the --serve-disagg
+    bench leg and of a real multi-host deployment."""
+    import argparse
+    import json
+    import signal
+    import sys
+
+    p = argparse.ArgumentParser(prog="remote_store")
+    p.add_argument("--budget-mb", type=int, default=256)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--uds", default=None, help="serve a unix socket path")
+    args = p.parse_args(argv)
+    server = PageStoreServer(
+        HostPageStore(args.budget_mb << 20),
+        host=args.host,
+        port=args.port,
+        path=args.uds,
+    ).start()
+    print(json.dumps({"endpoint": server.endpoint}), flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

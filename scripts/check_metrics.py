@@ -39,6 +39,8 @@ SCANNED = (
     "llm_consensus_tpu/serving/flight.py",
     "llm_consensus_tpu/serving/fleet.py",
     "llm_consensus_tpu/serving/control.py",
+    "llm_consensus_tpu/serving/disagg.py",
+    "llm_consensus_tpu/serving/remote_store.py",
     "llm_consensus_tpu/server/gateway.py",
     "llm_consensus_tpu/server/admission.py",
     "llm_consensus_tpu/consensus/coordinator.py",

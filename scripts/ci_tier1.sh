@@ -25,12 +25,12 @@ if [ "$1" = "--smoke" ]; then
     tests/test_ragged_attention.py tests/test_serve_speculative.py \
     tests/test_flight.py tests/test_decode_rounds.py \
     tests/test_mesh_serving.py tests/test_replica_fleet.py \
-    tests/test_adaptive_control.py \
+    tests/test_adaptive_control.py tests/test_disagg.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 set -o pipefail
 rm -f /tmp/_t1.log
-timeout -k 10 3600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+timeout -k 10 3900 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 exit $rc

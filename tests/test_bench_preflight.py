@@ -50,3 +50,55 @@ def test_await_chip_retries_until_budget(monkeypatch, tmp_path):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     assert bench._await_chip(budget_s=300, probe_timeout_s=10) is True
     assert marker.exists()
+
+
+# ---------------------------------------------------------------------------
+# Structured attempt reports + escalating backoff (PR 16)
+# ---------------------------------------------------------------------------
+
+
+def test_await_chip_attempts_record_success(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC", "pass")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    attempts = []
+    assert bench._await_chip(600, probe_timeout_s=60, attempts=attempts)
+    assert attempts[-1]["phase"] == "probe"
+    assert attempts[-1]["rc"] == 0
+    assert attempts[-1]["elapsed"] >= 0
+
+
+def test_await_chip_backoff_escalates_on_identical_failures(monkeypatch):
+    """Two identical consecutive (phase, rc) failures climb one rung
+    of _CHIP_BACKOFF_S: the sleep sequence runs 45, 90, 90, 180, ...
+    and every attempt lands a structured record in ``attempts``."""
+    monkeypatch.setattr(bench, "_PROBE_SRC", "import sys; sys.exit(7)")
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    attempts = []
+    assert (
+        bench._await_chip(2.0, probe_timeout_s=30, attempts=attempts)
+        is False
+    )
+    assert attempts and all(
+        a == {"phase": "probe", "rc": 7, "elapsed": a["elapsed"]}
+        for a in attempts
+    )
+    # Patching global time.sleep also records subprocess reaping polls;
+    # only the backoff rungs count.
+    rungs = [s for s in sleeps if s in bench._CHIP_BACKOFF_S]
+    expected = [45.0, 90.0, 90.0, 180.0]
+    assert rungs[: len(expected)] == expected[: len(rungs)]
+
+
+def test_await_chip_timeout_phase_recorded(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_PROBE_SRC", "import time; time.sleep(30)"
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    attempts = []
+    assert (
+        bench._await_chip(1.0, probe_timeout_s=0.3, attempts=attempts)
+        is False
+    )
+    assert attempts[0]["phase"] == "timeout"
+    assert attempts[0]["rc"] is None
