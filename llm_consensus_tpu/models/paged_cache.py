@@ -251,6 +251,25 @@ def install_page(
     )
 
 
+def install_pages(
+    cache: PagedKVCache,
+    pages: jnp.ndarray,  # [N]
+    k_pages: jnp.ndarray,  # [L, N, page_size, Hkv, Dh]
+    v_pages: jnp.ndarray,
+) -> PagedKVCache:
+    """:func:`install_page` for N pages in one scatter — the restore
+    half of the batching contract the demote side already keeps (one
+    ``device_get`` per evict walk): one host->device transfer and one
+    program launch per restore BATCH instead of per page. ``pages``
+    must be distinct (restore plans are, by construction: each page is
+    a different chain prefix)."""
+    k = cache.k.at[:, pages].set(k_pages.astype(cache.k.dtype))
+    v = cache.v.at[:, pages].set(v_pages.astype(cache.v.dtype))
+    return PagedKVCache(
+        k=k, v=v, page_table=cache.page_table, length=cache.length
+    )
+
+
 # ---------------------------------------------------------------------------
 # Host-side allocation: refcounted pages + prefix radix tree
 # ---------------------------------------------------------------------------

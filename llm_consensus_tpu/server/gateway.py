@@ -945,6 +945,18 @@ class Gateway:
         trace = _tracing.trace_store().start(
             "/v1/generate", route="/v1/generate"
         )
+        # Route-driven restore prefetch (PR 17): the destination is
+        # decided (single-replica backends) or about to be (the fleet
+        # prefetches again at route time), and the request is about to
+        # sit in the admission queue — free overlap for staging the
+        # chain's host-store pages. Non-blocking, advisory, and never
+        # allowed to fail the request.
+        pf = getattr(self.backend, "prefetch", None)
+        if callable(pf):
+            try:
+                pf(prompt)
+            except Exception:  # noqa: BLE001 - advisory path
+                log.exception("prefetch hook failed (ignored)")
         t0 = time.monotonic()
         if payload.get("stream"):
             try:

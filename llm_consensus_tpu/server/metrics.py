@@ -853,6 +853,47 @@ ROLE_HANDOFFS = REGISTRY.counter(
 
 
 # ---------------------------------------------------------------------------
+# Zero-copy pipelined KV movement plane (PR 17, serving/remote_store.py
+# wire v2 + streamed handoff + route-driven restore prefetch). Process-
+# global across a fleet like the PR-16 families above; per-replica
+# prefetch splits live in each batcher's stats() mirrors.
+# ---------------------------------------------------------------------------
+
+#: Plane PAYLOAD bytes moved over the page-store wire by this process's
+#: RemotePageStore clients, labeled ``dir="tx"|"rx"`` (tx = puts out,
+#: rx = get/get_run planes in). Framing/header overhead is excluded so
+#: the rate divides cleanly into pages/s; divide by
+#: gateway_remote_store_rtt_seconds_sum for effective wire throughput.
+TRANSFER_BYTES = REGISTRY.counter(
+    "gateway_transfer_bytes_total",
+    "KV plane payload bytes moved over the page-store wire by direction",
+)
+#: Route-driven restore prefetch outcomes, labeled ``event=``:
+#: ``fetched`` pages pulled store->host ahead of admission; ``hit``
+#: pages admission consumed from the prefetch cache (each one is a
+#: store round trip shaved off the restore flush); ``expired`` pages
+#: evicted from the bounded cache before any admission claimed them
+#: (wasted transfer — a high expired:fetched ratio means the router is
+#: prefetching chains that never arrive, or the cache cap is too
+#: small). Admission falls through expired entries to the store and
+#: then to recompute — never corrupt, only slower.
+KV_PREFETCH = REGISTRY.counter(
+    "gateway_kv_prefetch_total",
+    "Route-driven KV restore prefetch page outcomes",
+)
+#: Wall-clock from a decode replica claiming a cold chain to the chain
+#: fully exported and restorable (the prefill->decode handoff the
+#: HandoffCoordinator runs). The streamed path overlaps export with
+#: prefill compute, so this should hug the prefill time itself; the
+#: PR-16 synchronous path pays prefill + whole-chain export serially.
+HANDOFF_SECONDS = REGISTRY.histogram(
+    "gateway_handoff_seconds",
+    "Prefill-to-decode chain handoff wall-clock (claim to exported)",
+    buckets=LATENCY_BUCKETS,
+)
+
+
+# ---------------------------------------------------------------------------
 # Canonical manifest of families created on PER-INSTANCE registries
 # (gateway/admission accept an isolated MetricsRegistry for test
 # isolation, so their families cannot be module-level objects here).

@@ -88,7 +88,7 @@ import itertools
 import logging
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from functools import partial
@@ -122,6 +122,7 @@ from llm_consensus_tpu.models.paged_cache import (
     assign_pages,
     copy_page,
     install_page,
+    install_pages,
     install_seq,
     release_seq,
     write_prefill_kv,
@@ -244,6 +245,9 @@ from llm_consensus_tpu.server.metrics import (
 )
 from llm_consensus_tpu.server.metrics import (
     MESH_SHARDS as _M_MESH_SHARDS,
+)
+from llm_consensus_tpu.server.metrics import (
+    KV_PREFETCH as _M_PREFETCH,
 )
 from llm_consensus_tpu.utils import tracing as _tracing
 
@@ -906,8 +910,31 @@ class ContinuousBatcher:
         # the worker's dispatch-time buffer donation.
         self._preempt_req = 0
         self._preempted_pages = 0
+        # Export queue entries are mutable [ids, done, stream_until,
+        # spilled_pages]: a STREAMED export (PR 17) re-arms itself
+        # after each spill until the chain's usable pages are all out
+        # or the deadline passes, so transport overlaps the prefill
+        # still computing the later pages.
         self._exports: deque = deque()
         self._exported_pages = 0
+        # Route-driven restore prefetch (PR 17): a bounded host-side
+        # cache of chain pages pulled from the (remote) store AHEAD of
+        # admission, filled by a side thread so the store round trip
+        # never rides the worker loop or the admission lock. Admission
+        # consumes it in front of the store probe, shrinking a restore
+        # flush to a local install. Lock order: self._lock before
+        # _prefetch_lock, everywhere.
+        self._prefetched: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prefetch_lock = threading.Lock()
+        self._prefetch_q: deque = deque()
+        self._prefetch_have = threading.Event()
+        self._prefetch_thread: threading.Thread | None = None
+        # Entries, not bytes: a chain is at most pages_per_seq pages,
+        # so this holds a few routed-but-not-yet-admitted chains.
+        self._prefetch_cap = max(16, 4 * c.pages_per_seq)
+        self._prefetch_fetched = 0
+        self._prefetch_hits = 0
+        self._prefetch_expired = 0
         # Pending page restores: (registry node, host planes). Filled at
         # admission, drained one page per loop iteration between decode
         # steps (the same bounded-stall discipline as prefill chunks);
@@ -1102,6 +1129,12 @@ class ContinuousBatcher:
         self._jit_fused = {}  # (chunk, s_bucket) -> compiled fused step
         self._jit_copy_page = jax.jit(copy_page, donate_argnums=(0,))
         self._jit_install_page = jax.jit(install_page, donate_argnums=(0,))
+        # Batched restore install (PR 17): one scatter per restore
+        # BATCH — jit caches one trace per batch size actually seen
+        # (1 and the controller's restore_batch, in practice).
+        self._jit_install_pages = jax.jit(
+            install_pages, donate_argnums=(0,)
+        )
         self._jit_unembed = jax.jit(partial(unembed_one, self.cfg))
         # Speculative state (PR 9). _spec_cfg pins the MoE dispatch of
         # the k+1-token verify rows to the plain decode step's choice,
@@ -2042,13 +2075,24 @@ class ContinuousBatcher:
                 k = t // pg
                 h = 0
                 if self._offload is not None:
-                    while (
-                        k + h < usable_full
-                        and h < _PROBE_HOST_PAGES
-                        and self._store_key(chain[: (k + h + 1) * pg])
-                        in self._offload
-                    ):
-                        h += 1
+                    # One batched run_len probe per registry (PR 17):
+                    # over the remote store this is a single RTT for
+                    # the whole capped extension walk instead of up to
+                    # _PROBE_HOST_PAGES sequential __contains__ calls.
+                    cap = min(usable_full - k, _PROBE_HOST_PAGES)
+                    if cap > 0:
+                        keys = [
+                            self._store_key(chain[: (k + j + 1) * pg])
+                            for j in range(cap)
+                        ]
+                        rl = getattr(self._offload, "run_len", None)
+                        if rl is not None:
+                            h = rl(keys)
+                        else:
+                            for key in keys:
+                                if key not in self._offload:
+                                    break
+                                h += 1
                 best = max(best, (t, h * pg))
         return {"registry_tokens": best[0], "host_tokens": best[1]}
 
@@ -2173,21 +2217,171 @@ class ContinuousBatcher:
             self._preempt_req = max(self._preempt_req, int(n_pages))
         self._work.set()
 
-    def request_export(self, ids) -> threading.Event:
+    def request_export(
+        self, ids, stream_until: float | None = None
+    ) -> threading.Event:
         """Ask the worker to spill the READY resident pages of this
         prompt's registered prefix chain to the (shared) host store
         WITHOUT evicting them — the rebalance transport: the chain
         stays hot here and becomes restorable on any replica sharing
         the store. Returns an Event set when the spill has run (set
-        immediately when the tier is off — nothing to do)."""
+        immediately when the tier is off — nothing to do).
+
+        With ``stream_until`` (a ``time.monotonic`` deadline) the
+        export STREAMS (PR 17): each worker iteration spills the pages
+        that became ready since the last pass — so while a chunked
+        prefill is still computing the chain's tail, the head is
+        already crossing the wire — and the export re-arms itself
+        until every usable chain page is out (then the event sets) or
+        the deadline passes (the event sets with whatever made it;
+        the coordinator's own wait bounds the handoff either way)."""
         done = threading.Event()
         if self._offload is None:
             done.set()
             return done
         with self._lock:
-            self._exports.append((np.asarray(ids, np.int32), done))
+            self._exports.append(
+                [np.asarray(ids, np.int32), done, stream_until, 0]
+            )
         self._work.set()
         return done
+
+    # -- route-driven restore prefetch (PR 17) --------------------------
+    # When the fleet router picks THIS replica as a request's
+    # destination, the chain's host-store pages are known before the
+    # request clears the gateway queue + admission. prefetch_chain()
+    # pulls them store -> local staging (the expensive remote hop) on a
+    # side thread so admission's restore plan starts from staged planes
+    # instead of a cold round trip; the device_put half still happens
+    # on the worker (restore discipline unchanged). Wrong-guess safety:
+    # entries are chain-keyed (content deterministic in the key), so a
+    # stale or evicted guess can never corrupt — it just falls through
+    # to get_run/recompute. The staging dict is byte-bounded by entry
+    # COUNT (a few chains' worth) and LRU-evicts, counted as "expired".
+
+    def prefetch_chain(self, ids) -> bool:
+        """Queue a speculative store->host pull of this prompt's chain
+        (gateway/router thread; non-blocking). Returns False when
+        there is nothing to prefetch (no offload tier, sharing off,
+        sub-page prompt, or the queue is saturated)."""
+        c = self.config
+        if self._offload is None or not c.share_prefix:
+            return False
+        if (len(ids) - 1) // c.page_size <= 0:
+            return False
+        with self._prefetch_lock:
+            if len(self._prefetch_q) >= 32:
+                return False  # saturated: drop, never block the router
+            self._prefetch_q.append(np.asarray(ids, np.int32))
+            if self._prefetch_thread is None:
+                self._prefetch_thread = threading.Thread(
+                    target=self._prefetch_loop,
+                    name="kv-prefetch",
+                    daemon=True,
+                )
+                self._prefetch_thread.start()
+        self._prefetch_have.set()
+        return True
+
+    def _prefetch_loop(self) -> None:
+        while not self._stop.is_set():
+            self._prefetch_have.wait(timeout=0.2)
+            while True:
+                with self._prefetch_lock:
+                    if not self._prefetch_q:
+                        self._prefetch_have.clear()
+                        break
+                    ids = self._prefetch_q.popleft()
+                if self._stop.is_set():
+                    return
+                try:
+                    self._prefetch_one(ids)
+                except Exception:  # noqa: BLE001 — advisory path
+                    log.exception("kv prefetch failed (ignored)")
+
+    def _prefetch_one(self, ids) -> None:
+        """Pull one chain's restorable pages store -> staging. Probes
+        the registries first so device-resident pages aren't refetched;
+        skips keys already staged; stages the contiguous run the store
+        holds past that point."""
+        c = self.config
+        pg = c.page_size
+        usable_full = (len(ids) - 1) // pg
+        chain = tuple(int(t) for t in ids[: usable_full * pg])
+        with self._lock:
+            k = 0
+            for reg in self._registries:
+                _, t = reg.probe(ids)
+                k = max(k, t // pg)
+        keys = [
+            self._store_key(chain[: (j + 1) * pg])
+            for j in range(k, usable_full)
+        ]
+        with self._prefetch_lock:
+            while keys and keys[0] in self._prefetched:
+                self._prefetched.move_to_end(keys[0])
+                keys.pop(0)
+        if not keys:
+            return
+        store = self._offload
+        gr = getattr(store, "get_run", None)
+        if gr is not None:
+            run = gr(keys)
+        else:
+            run = []
+            for key in keys:
+                planes = store.get(key)
+                if planes is None:
+                    break
+                run.append(planes)
+        if not run:
+            return
+        expired = 0
+        with self._prefetch_lock:
+            for key, planes in zip(keys, run):
+                self._prefetched[key] = planes
+                self._prefetched.move_to_end(key)
+            while len(self._prefetched) > self._prefetch_cap:
+                self._prefetched.popitem(last=False)
+                expired += 1
+            self._prefetch_fetched += len(run)
+            self._prefetch_expired += expired
+        _M_PREFETCH.labels(event="fetched").inc(len(run))
+        if expired:
+            _M_PREFETCH.labels(event="expired").inc(expired)
+        _flight.flight_recorder().record(
+            "prefetch", time.perf_counter(), pages=len(run),
+            expired=expired,
+        )
+
+    def _prefetch_take(self, keys: list) -> list:
+        """Consume the staged contiguous prefix of ``keys`` (admission
+        path, caller holds ``self._lock`` — lock order is always
+        _lock -> _prefetch_lock). Taken entries leave the staging dict:
+        their planes transfer to the restore plan."""
+        out: list = []
+        with self._prefetch_lock:
+            for key in keys:
+                planes = self._prefetched.pop(key, None)
+                if planes is None:
+                    break
+                out.append(planes)
+            if out:
+                self._prefetch_hits += len(out)
+        if out:
+            _M_PREFETCH.labels(event="hit").inc(len(out))
+        return out
+
+    def _prefetch_stats(self) -> dict:
+        """Stats()-shaped prefetch counters (lock order: the caller
+        holds ``self._lock``; _prefetch_lock nests inside it)."""
+        with self._prefetch_lock:
+            return {
+                "prefetch_fetched_pages": self._prefetch_fetched,
+                "prefetch_hit_pages": self._prefetch_hits,
+                "prefetch_expired_pages": self._prefetch_expired,
+                "prefetch_staged_pages": len(self._prefetched),
+            }
 
     def _preempt_step(self) -> None:
         """Worker-side execution of queued preempt requests: one
@@ -2219,13 +2413,24 @@ class ContinuousBatcher:
         """Worker-side execution of ONE queued chain export per loop
         iteration (the same bounded-stall discipline as restores):
         probe the registries for the chain's resident nodes, spill the
-        ready ones the store doesn't already hold."""
+        ready ones the store doesn't already hold.
+
+        STREAMING exports (PR 17, ``stream_until`` set) spill only the
+        DELTA of pages that became ready since their last pass, then
+        re-arm at the back of the queue until the whole usable chain is
+        out or the deadline passes — overlapping the wire transfer with
+        the chunked prefill that is still computing the chain's tail.
+        Re-arming deliberately does NOT set ``_work``: the worker's
+        idle tick (the 0.1 s ``_work.wait`` timeout) repolls a pending
+        stream without busy-spinning an otherwise idle loop."""
         if not self._exports:
             return
+        streaming = False
         with self._lock:
             if not self._exports:
                 return
-            ids, done = self._exports.popleft()
+            entry = self._exports.popleft()
+            ids, done, stream_until, spilled = entry
             nodes: list = []
             for reg in self._registries:
                 cand, _ = reg.probe(ids)
@@ -2233,14 +2438,30 @@ class ContinuousBatcher:
                     nodes = cand
             ready = [n for n in nodes if n.ready]
             fetched = 0
-            if ready:
-                fetched, _ = self._spill_nodes(ready)
+            if len(ready) > spilled:
+                # Delta-spill: earlier passes of this streamed export
+                # already pushed ready[:spilled] (ready order is chain
+                # order — pages become ready root-first).
+                fetched, _ = self._spill_nodes(ready[spilled:])
+                entry[3] = len(ready)
             self._exported_pages += fetched
-        _flight.flight_recorder().record(
-            "export", time.perf_counter(), pages=fetched,
-            resident=len(ready),
-        )
-        done.set()
+            if stream_until is not None:
+                expected = (len(ids) - 1) // self.config.page_size
+                if (
+                    len(ready) < expected
+                    and time.monotonic() < stream_until
+                ):
+                    streaming = True
+                    self._exports.append(entry)
+        if fetched or not streaming:
+            # Quiet re-poll passes (streamed export waiting on prefill
+            # progress) don't spam the flight ring.
+            _flight.flight_recorder().record(
+                "export", time.perf_counter(), pages=fetched,
+                resident=len(ready), streaming=streaming,
+            )
+        if not streaming:
+            done.set()
 
     def stats(self) -> dict:
         """Live serving counters — a consistent snapshot (the worker
@@ -2307,6 +2528,24 @@ class ContinuousBatcher:
                 # (resident here AND restorable fleet-wide).
                 "preempted_pages": self._preempted_pages,
                 "exported_pages": self._exported_pages,
+                # Route-driven restore prefetch (PR 17): pages staged
+                # store->host ahead of admission, staged pages the
+                # restore planner consumed, and staged pages the LRU
+                # cap expired unconsumed (mirrors of
+                # gateway_kv_prefetch_total, lockstep tested). Wire
+                # bytes mirror the remote store client's own counters
+                # (0 for an in-process tier — no wire).
+                **self._prefetch_stats(),
+                "offload_wire_tx_bytes": (
+                    getattr(self._offload, "tx_bytes", 0)
+                    if self._offload
+                    else 0
+                ),
+                "offload_wire_rx_bytes": (
+                    getattr(self._offload, "rx_bytes", 0)
+                    if self._offload
+                    else 0
+                ),
                 # Span-derived step telemetry (PR 5): the same
                 # observations that feed gateway_decode_step_seconds /
                 # gateway_sched_overhead_seconds — one instrumentation
@@ -2415,11 +2654,14 @@ class ContinuousBatcher:
     def close(self) -> None:
         self._stop.set()
         self._work.set()
+        self._prefetch_have.set()  # wake the prefetch loop to exit
         self._thread.join(timeout=10)
+        if self._prefetch_thread is not None:
+            self._prefetch_thread.join(timeout=5)
         with self._lock:
             # Pending rebalance exports never run now — release their
             # waiters rather than leaving them to time out.
-            for _, ev in self._exports:
+            for _, ev, *_rest in self._exports:
                 ev.set()
             self._exports.clear()
             for req in self._waiting:
@@ -2630,14 +2872,32 @@ class ContinuousBatcher:
                             chain = tuple(
                                 int(t) for t in ids[: usable_full * pg]
                             )
-                        while k < usable_full:
-                            planes = self._offload.get(
-                                self._store_key(chain[: (k + 1) * pg])
-                            )
-                            if planes is None:
-                                break
-                            restore_plan.append(planes)
-                            k += 1
+                            keys = [
+                                self._store_key(chain[: (j + 1) * pg])
+                                for j in range(k, usable_full)
+                            ]
+                            # Route-driven prefetch hits first (PR 17):
+                            # planes the prefetch loop already pulled
+                            # store->host for this chain are consumed
+                            # here without touching the store again.
+                            restore_plan = self._prefetch_take(keys)
+                            if len(restore_plan) < len(keys):
+                                # One batched get_run for the rest —
+                                # over the remote transport the whole
+                                # restore plan is a single round trip
+                                # (scatter-gather reply), not one RTT
+                                # per page.
+                                gr = getattr(self._offload, "get_run", None)
+                                if gr is not None:
+                                    restore_plan.extend(
+                                        gr(keys[len(restore_plan):])
+                                    )
+                                else:
+                                    for key in keys[len(restore_plan):]:
+                                        planes = self._offload.get(key)
+                                        if planes is None:
+                                            break
+                                        restore_plan.append(planes)
                         if restore_plan:
                             # Full-page restores supersede the partial
                             # boundary ON THE MATCH TOO: record_commit
@@ -2830,11 +3090,22 @@ class ContinuousBatcher:
         dispatch-time buffer donation.
         """
         store = self._offload
-        fetch: list[tuple[tuple, int]] = []
+        keys = [
+            self._store_key(PrefixRegistry.chain_tokens(node))
+            for node in nodes
+        ]
         refreshed = demoted = dropped = 0
-        for node in nodes:
-            key = self._store_key(PrefixRegistry.chain_tokens(node))
-            if store.touch(key):
+        # Batched recency probe (PR 17): over the remote transport
+        # touch_many is ONE round trip for the whole spill plan instead
+        # of a serial RTT per chain. In-process stores answer the same
+        # surface; a store without it falls back to the per-key loop.
+        tm = getattr(store, "touch_many", None)
+        touched = (
+            tm(keys) if tm is not None else [store.touch(k) for k in keys]
+        )
+        fetch: list[tuple[tuple, int]] = []
+        for key, node, hit in zip(keys, nodes, touched):
+            if hit:
                 refreshed += 1
                 demoted += 1
             else:
@@ -2852,13 +3123,25 @@ class ContinuousBatcher:
                     self.draft_cache.v[:, pages],
                 ]
             got = jax.device_get(tuple(planes_dev))  # [L, n, page, Hkv, Dh]
-            for i, (key, _) in enumerate(fetch):
-                # Contiguous copies: a view into the batch buffer would
-                # pin the whole [L, n, ...] fetch alive in the store.
-                _, d, dr = store.put_counted(
+            # Contiguous copies: a view into the batch buffer would
+            # pin the whole [L, n, ...] fetch alive in the store.
+            items = [
+                (
                     key,
                     tuple(np.ascontiguousarray(pl[:, i]) for pl in got),
                 )
+                for i, (key, _) in enumerate(fetch)
+            ]
+            # One put_many per spill burst: remotely that's one frame
+            # carrying every page's planes scatter-gathered (the v2
+            # batched put), locally it loops put_counted under the hood.
+            pm = getattr(store, "put_many", None)
+            deltas = (
+                pm(items)
+                if pm is not None
+                else [store.put_counted(k, p) for k, p in items]
+            )
+            for _, d, dr in deltas:
                 demoted += d
                 dropped += dr
         if demoted:
@@ -2907,40 +3190,58 @@ class ContinuousBatcher:
         # before installing host content into pool pages (once for the
         # whole batch — the amortization restore_batch sizes).
         self._flush_pipeline()
-        restored = 0
-        while self._restores and restored < batch:
-            node, planes, trace = self._restores.popleft()
-            t0 = time.perf_counter()
-            self.cache = self._jit_install_page(
-                self.cache,
-                jnp.int32(node.page),
-                jnp.asarray(planes[0]),
-                jnp.asarray(planes[1]),
+        group: list = []
+        while self._restores and len(group) < batch:
+            group.append(self._restores.popleft())
+        # Batched install (PR 17, the page_planes docstring's demote
+        # symmetry): ONE stacked device_put + scatter covers the whole
+        # group instead of a dispatch per page — restore bursts (a
+        # handoff's chain, a promote-back after preemption) cost one
+        # transfer the way a demote burst costs one device_get.
+        t0 = time.perf_counter()
+        pages = jnp.asarray([int(n.page) for n, _, _ in group], jnp.int32)
+        self.cache = self._jit_install_pages(
+            self.cache,
+            pages,
+            jnp.asarray(np.stack([p[0] for _, p, _ in group], axis=1)),
+            jnp.asarray(np.stack([p[1] for _, p, _ in group], axis=1)),
+        )
+        draft_idx = [
+            i for i, (_, p, _) in enumerate(group) if len(p) >= 4
+        ]
+        if self.draft_cache is not None and draft_idx:
+            # Draft planes demoted alongside the target's (PR 9):
+            # the restored prefix keeps its draft context, so
+            # acceptance doesn't silently collapse after an
+            # eviction round trip.
+            self.draft_cache = self._jit_install_pages(
+                self.draft_cache,
+                pages[jnp.asarray(draft_idx, jnp.int32)],
+                jnp.asarray(
+                    np.stack([group[i][1][2] for i in draft_idx], axis=1)
+                ),
+                jnp.asarray(
+                    np.stack([group[i][1][3] for i in draft_idx], axis=1)
+                ),
             )
-            if self.draft_cache is not None and len(planes) >= 4:
-                # Draft planes demoted alongside the target's (PR 9):
-                # the restored prefix keeps its draft context, so
-                # acceptance doesn't silently collapse after an
-                # eviction round trip.
-                self.draft_cache = self._jit_install_page(
-                    self.draft_cache,
-                    jnp.int32(node.page),
-                    jnp.asarray(planes[2]),
-                    jnp.asarray(planes[3]),
-                )
-            # The install must COMPLETE before readers are released
-            # (same contract as a prefill chunk's block) — and the
-            # histogram's point is the true host->device promotion
-            # latency, observed per page.
-            jax.block_until_ready(self.cache.length)
-            dur = time.perf_counter() - t0
-            _M_RESTORE_SECONDS.observe(dur)
+        # The install must COMPLETE before readers are released (same
+        # contract as a prefill chunk's block). The histogram stays a
+        # per-PAGE promotion latency: the batch's wall time amortizes
+        # evenly over its pages (dur/n observed n times), keeping the
+        # family's count == restored-pages lockstep with
+        # offload_restored_total.
+        jax.block_until_ready(self.cache.length)
+        dur = time.perf_counter() - t0
+        per = dur / len(group)
+        for i, (node, _, trace) in enumerate(group):
+            ti = t0 + i * per
+            _M_RESTORE_SECONDS.observe(per)
             if trace is not None:
-                trace.add_span("kv_restore", t0, dur, page=int(node.page))
+                trace.add_span("kv_restore", ti, per, page=int(node.page))
             _flight.flight_recorder().record(
                 "restore",
-                t0,
-                dur,
+                ti,
+                per,
                 trace_id=_tracing.trace_id_of(trace),
                 page=int(node.page),
             )
@@ -2948,9 +3249,8 @@ class ContinuousBatcher:
             _M_OFF_RESTORED.inc()
             if self.controller is not None:
                 self.controller.note_restore(self.host_page_bytes)
-            restored += 1
         with self._lock:
-            self._offload_restored += restored
+            self._offload_restored += len(group)
         return True
 
     def _count_program(
@@ -4448,6 +4748,18 @@ class ContinuousBackend(_backend_base.Backend):
         """``/debug/chains`` probe surface: how much of this prompt's
         prefix chain is resident here (PR 16 peer routing)."""
         return self.batcher.prefix_probe(ids)
+
+    def prefetch(self, prompt: str) -> bool:
+        """Gateway enqueue-time prefetch hook (PR 17): the single-
+        replica deployment's destination is always THIS batcher, so
+        the admission-queue wait is free overlap — stage the prompt's
+        host-store pages now and the restore plan at admission finds
+        them staged. Non-blocking (a queue append); advisory (a wrong
+        guess falls through to get_run/recompute)."""
+        ids = self.batcher.tokenizer.encode(prompt)
+        return self.batcher.prefetch_chain(
+            ids[-self.batcher.config.seq_buckets[-1]:]
+        )
 
     def request_cost(self, prompt: str, max_new_tokens: int) -> float:
         """Modeled bytes of one request's whole schedule — the

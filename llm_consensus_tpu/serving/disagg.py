@@ -57,6 +57,9 @@ import threading
 import time
 from dataclasses import replace
 
+from llm_consensus_tpu.server.metrics import (
+    HANDOFF_SECONDS as _M_HANDOFF_SECONDS,
+)
 from llm_consensus_tpu.server.metrics import ROLE_HANDOFFS as _M_HANDOFFS
 from llm_consensus_tpu.serving import flight as _flight
 from llm_consensus_tpu.serving.continuous import ContinuousConfig
@@ -131,8 +134,12 @@ class HandoffCoordinator:
         self._seen: dict[tuple, float] = {}
         #: Completed handoffs (stats() mirror of
         #: ``gateway_role_handoffs_total``'s increments from this
-        #: fleet; the Prometheus family is process-global).
+        #: fleet; the Prometheus family is process-global), plus the
+        #: claim-to-exported latency mirror of
+        #: ``gateway_handoff_seconds`` (PR 17, lockstep tested).
         self.handoffs = 0
+        self.handoff_seconds_sum = 0.0
+        self.handoff_seconds_count = 0
 
     def _prefill_candidates(self) -> list[int]:
         healthy = set(self.fleet.router.healthy())
@@ -212,12 +219,32 @@ class HandoffCoordinator:
             log.warning("handoff warm-up submit failed: %s", e)
             return False
         wait_s = fleet.fleet_config.handoff_wait_s
+        streamed = (
+            fleet.fleet_config.handoff_stream and wait_s > 0
+        )
+        # Streamed handoff (PR 17): issue the STREAMING export NOW —
+        # while the warm-up prefill is still computing the chain's
+        # tail, the export step is already spilling each chunk's pages
+        # as they flip ready, so the store (the wire, when it is
+        # remote) transfers OVERLAP the prefill instead of serializing
+        # after it. The non-streamed path (handoff_stream=False, the
+        # PR-16 shape and the bench A/B's baseline) exports the whole
+        # chain in one pass after the warm-up completes.
+        ev_stream = None
+        if streamed:
+            ev_stream = fleet.batchers[src].request_export(
+                ids, stream_until=time.monotonic() + wait_s
+            )
+        deadline = time.monotonic() + wait_s
 
         def finish() -> None:
             try:
                 fut.result(timeout=wait_s)
-                ev = fleet.batchers[src].request_export(ids)
-                if not ev.wait(wait_s):
+                if ev_stream is not None:
+                    ev = ev_stream
+                else:
+                    ev = fleet.batchers[src].request_export(ids)
+                if not ev.wait(max(0.0, deadline - time.monotonic())):
                     log.warning(
                         "handoff export from replica %d did not land "
                         "within %.1fs; decode side may re-prefill",
@@ -228,15 +255,23 @@ class HandoffCoordinator:
             except Exception as e:  # noqa: BLE001 - degrade, never wedge
                 log.warning("handoff via replica %d failed: %s", src, e)
                 return
+            dur = time.perf_counter() - t0
             _M_HANDOFFS.inc()
+            # Claim-to-exported latency: the window the decode side
+            # would otherwise re-prefill in. The streamed-vs-sync
+            # bench A/B reads this family's delta.
+            _M_HANDOFF_SECONDS.observe(dur)
             with self._lock:
                 self.handoffs += 1
+                self.handoff_seconds_sum += dur
+                self.handoff_seconds_count += 1
             _flight.flight_recorder().record(
                 "handoff",
                 t0,
-                time.perf_counter() - t0,
+                dur,
                 src=src,
                 chain_pages=len(chain),
+                streamed=streamed,
             )
 
         if wait_s > 0 and self._off_loop():

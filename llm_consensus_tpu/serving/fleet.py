@@ -162,6 +162,21 @@ class FleetConfig:
     #: completes on a daemon thread instead (the same rule as
     #: rebalance_export_wait_s). 0 = always hand off asynchronously.
     handoff_wait_s: float = 60.0
+    #: Streamed handoffs (PR 17): the coordinator issues the chain
+    #: export as a STREAM alongside the warm-up prefill, so ready
+    #: pages cross the (possibly remote) store wire while the tail is
+    #: still computing. False restores the PR-16 sequential shape
+    #: (prefill completes, then one whole-chain export) — the bench
+    #: transport A/B's baseline.
+    handoff_stream: bool = True
+    #: Route-driven restore prefetch (PR 17): after the router picks a
+    #: request's destination replica, speculatively stage the chain's
+    #: host-store pages store->local on that replica (a side thread)
+    #: so admission's restore plan finds them staged instead of paying
+    #: a synchronous store round trip. Advisory only — a wrong or
+    #: expired guess falls through to the normal get_run/recompute
+    #: path (chain-keyed entries can never corrupt).
+    prefetch: bool = True
 
 
 class PrefixRouter:
@@ -531,6 +546,15 @@ class ReplicaSet:
             self.handoff.ensure_prefilled(prompt, ids, chain)
         idx, reason = self.router.route(ids, chain=chain)
         self._count_route(idx, reason, chain)
+        if self.fleet_config.prefetch and self.store is not None:
+            # Route-driven restore prefetch (PR 17): the destination
+            # is known NOW, admission happens later on the replica's
+            # worker — stage the chain's store pages on a side thread
+            # in between so the restore plan starts from staged planes
+            # (one remote round trip saved per restorable page run).
+            # Non-blocking and advisory; registry-resident pages are
+            # skipped by the prefetcher's own probe.
+            self.batchers[idx].prefetch_chain(ids)
         return self.batchers[idx].submit(
             prompt, prompt_ids=full_ids, **kw
         )
@@ -735,6 +759,18 @@ class ReplicaSet:
             "roles": list(self.roles),
             "role_handoffs": (
                 self.handoff.handoffs if self.handoff is not None else 0
+            ),
+            # Claim-to-exported handoff latency (PR 17) — the stats()
+            # mirror of gateway_handoff_seconds (lockstep tested).
+            "handoff_seconds_sum": (
+                self.handoff.handoff_seconds_sum
+                if self.handoff is not None
+                else 0.0
+            ),
+            "handoff_seconds_count": (
+                self.handoff.handoff_seconds_count
+                if self.handoff is not None
+                else 0
             ),
             "per_replica": per,
             "routed": routed,

@@ -202,6 +202,52 @@ class HostPageStore:
             self.hits += 1
             return planes
 
+    # -- batched surface (PR 17) ----------------------------------------
+    # One call per PLAN instead of one per page: over the remote
+    # transport each method below is a single round trip, and the
+    # in-process implementations here keep the interface identical so
+    # the batcher never branches on store locality. Each loops the
+    # per-key primitive (one lock acquisition per key) — exactness of
+    # the LRU/byte accounting matters more than shaving lock hops in
+    # a host-RAM tier whose unit of work is a megabyte-scale memcpy.
+
+    def put_many(
+        self, items: Sequence[tuple[tuple, Sequence[np.ndarray]]]
+    ) -> list[tuple[bool, int, int]]:
+        """:meth:`put_counted` for a batch; one delta triple per item,
+        in order."""
+        return [self.put_counted(key, planes) for key, planes in items]
+
+    def touch_many(self, keys: Sequence[tuple]) -> list[bool]:
+        """:meth:`touch` for a batch; one residency flag per key."""
+        return [self.touch(k) for k in keys]
+
+    def get_run(self, keys: Sequence[tuple]) -> list[Planes]:
+        """Planes for the longest contiguous PREFIX of ``keys`` that is
+        resident, stopping at the first miss. Chain keys are prefix-
+        nested (page k+1's chain extends page k's), so a restore plan
+        only ever wants a prefix run — a hit after a gap could not be
+        installed anyway. Recency refreshes exactly like :meth:`get`."""
+        out: list[Planes] = []
+        for k in keys:
+            planes = self.get(k)
+            if planes is None:
+                break
+            out.append(planes)
+        return out
+
+    def run_len(self, keys: Sequence[tuple]) -> int:
+        """Length of the contiguous resident prefix of ``keys`` WITHOUT
+        moving plane bytes or recency (pure probe — the router's
+        prefix_probe extension walk)."""
+        n = 0
+        with self._lock:
+            for k in keys:
+                if k not in self._entries:
+                    break
+                n += 1
+        return n
+
     def stats_snapshot(self) -> dict:
         """Every counter plus occupancy, read under ONE lock hold — the
         consistent view the remote page-store server piggybacks on each
