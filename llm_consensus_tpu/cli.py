@@ -104,6 +104,13 @@ def _build_backend(args):
     from llm_consensus_tpu.models.configs import get_config
     from llm_consensus_tpu.models.transformer import init_params
 
+    if getattr(args, "model_spec", None):
+        if args.backend != "continuous":
+            raise SystemExit(
+                "--model-spec needs --backend continuous (the "
+                "multi-model plane is built from continuous engines)"
+            )
+        return _build_modelset_backend(args)
     if args.hf_checkpoint:
         from llm_consensus_tpu.models.hf_loader import (
             config_from_hf,
@@ -272,6 +279,117 @@ def _build_backend(args):
         draft=draft,
     )
     return LocalBackend(engine)
+
+
+def _parse_model_spec(raw: str) -> dict[str, str]:
+    """``"name=large,preset=llama-1b,draft_from=small"`` -> dict.
+    Validates keys at parse time so a typo is argparse-style usage
+    feedback, not a KeyError mid-engine-build."""
+    allowed = {
+        "name", "preset", "checkpoint", "tokenizer", "slots",
+        "spec_k", "replicas", "adaptive", "draft_from",
+    }
+    kv: dict[str, str] = {}
+    for part in raw.split(","):
+        k, sep, v = part.partition("=")
+        k = k.strip()
+        if not sep or not k or not v.strip():
+            raise SystemExit(
+                f"bad --model-spec entry {part!r} (want KEY=VAL,...)"
+            )
+        if k not in allowed:
+            raise SystemExit(
+                f"unknown --model-spec key {k!r} (have {sorted(allowed)})"
+            )
+        kv[k] = v.strip()
+    for req in ("name", "preset"):
+        if req not in kv:
+            raise SystemExit(f"--model-spec needs {req}= (got {raw!r})")
+    return kv
+
+
+def _build_modelset_backend(args):
+    """Build the multi-model serving plane (PR 18) from --model-spec
+    flags: one engine per member, cross-model draft pairings resolved
+    through vocab alignment, one ModelSetBackend behind the gateway.
+    Global continuous-serving flags (--prefill-chunk, --host-cache-mb,
+    --decode-rounds, ...) set every member's baseline; per-member keys
+    (slots, spec_k, replicas, adaptive) override. Each member gets its
+    OWN ContinuousConfig instance — the live-knob aliasing contract is
+    per model, never across models."""
+    import jax
+
+    from llm_consensus_tpu.engine.tokenizer import load_tokenizer
+    from llm_consensus_tpu.models.configs import get_config
+    from llm_consensus_tpu.models.transformer import init_params
+    from llm_consensus_tpu.serving.continuous import ContinuousConfig
+    from llm_consensus_tpu.serving.control import (
+        ControlConfig,
+        resolve_hbm_gbps,
+    )
+    from llm_consensus_tpu.serving.fleet import FleetConfig
+    from llm_consensus_tpu.serving.modelset import (
+        ModelSet,
+        ModelSetBackend,
+        ModelSpec,
+    )
+
+    specs = []
+    for i, raw in enumerate(args.model_spec):
+        kv = _parse_model_spec(raw)
+        cfg = get_config(kv["preset"])
+        if kv.get("checkpoint"):
+            params = _load_checkpoint_params(cfg, kv["checkpoint"])
+        else:
+            log.warning(
+                "member %r: no checkpoint — RANDOM weights for %s "
+                "(plumbing only; text will be gibberish).",
+                kv["name"],
+                cfg.name,
+            )
+            # Distinct seed per member: two members of the same preset
+            # must not alias weights (their store scopes and consensus
+            # roles differ).
+            params = init_params(cfg, jax.random.PRNGKey(i))
+        pairs = bool(kv.get("draft_from"))
+        config = ContinuousConfig(
+            max_slots=int(kv.get("slots", args.serve_slots)),
+            max_new_tokens=args.max_new_tokens,
+            prefill_chunk=args.prefill_chunk,
+            share_prefix=not args.no_share_prefix,
+            host_cache_bytes=args.host_cache_mb << 20,
+            pipeline_depth=args.pipeline_depth,
+            ragged_attention=not args.no_ragged_attention,
+            spec_k=int(kv.get("spec_k", args.spec_k)) if pairs else 0,
+            decode_rounds=args.decode_rounds,
+            hbm_gbps=resolve_hbm_gbps(args.hbm_gbps),
+        )
+        replicas = int(kv.get("replicas", 1))
+        fleet = None
+        if replicas > 1:
+            fleet = FleetConfig(
+                replicas=replicas,
+                ready_stall_s=getattr(args, "ready_stall_s", 10.0),
+            )
+        adaptive = kv.get("adaptive")
+        control = None
+        if adaptive == "1" or (adaptive is None and args.adaptive):
+            control = ControlConfig()
+        specs.append(
+            ModelSpec(
+                name=kv["name"],
+                cfg=cfg,
+                params=params,
+                tokenizer=load_tokenizer(
+                    kv.get("tokenizer") or args.tokenizer
+                ),
+                config=config,
+                fleet=fleet,
+                draft_from=kv.get("draft_from"),
+                control=control,
+            )
+        )
+    return ModelSetBackend(ModelSet(specs, default=args.model_default))
 
 
 def _add_backend_args(p: argparse.ArgumentParser) -> None:
@@ -465,6 +583,34 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
         help="shard the local engine over a device mesh, e.g. "
         "'data=4,model=2' (axes: data/model/expert/seq/pipe; product "
         "must equal the device count; seq>1 enables ring attention)",
+    )
+    p.add_argument(
+        "--model-spec",
+        action="append",
+        default=None,
+        metavar="KEY=VAL[,KEY=VAL...]",
+        help="continuous backend: one multi-model SET member per flag "
+        "(PR 18) — repeat to add members; overrides --model/"
+        "--draft-model. Keys: name (required), preset (required "
+        "model-config preset), checkpoint, tokenizer, slots, spec_k, "
+        "replicas, adaptive=0/1, draft_from=<member> (mount that "
+        "member's weights as this member's speculative draft across "
+        "the tokenizer boundary via exact-match vocab alignment). "
+        "Example: --model-spec name=large,preset=llama-1b,"
+        "draft_from=small --model-spec name=small,preset=llama-debug",
+    )
+    p.add_argument(
+        "--model-default",
+        default=None,
+        help="multi-model: member serving untagged requests (default: "
+        "the first --model-spec)",
+    )
+    p.add_argument(
+        "--model-lanes",
+        action="store_true",
+        help="multi-model: add one model:<name> admission lane per "
+        "member — requests tagged with a model queue behind their own "
+        "bound instead of the shared interactive lane",
     )
 
 
@@ -773,6 +919,15 @@ def _run_serve(argv: list[str]) -> int:
     _flight.flight_recorder().configure(capacity=args.flight_events)
     panel = load_panel(args.panel) if args.panel else default_panel()
     backend = _build_backend(args)
+    # Per-model admission lanes (PR 18): a multi-model backend adds one
+    # ``model:<name>`` priority lane per member behind the base pair —
+    # a request tagged with a model defaults into its own lane (the
+    # gateway's _lane_for), so one member's burst queues behind its own
+    # bound instead of starving the panel's other models.
+    priorities: tuple[str, ...] = ("interactive", "batch")
+    modelset = getattr(backend, "modelset", None)
+    if modelset is not None and args.model_lanes:
+        priorities = priorities + modelset.admission_lanes()
     gateway = Gateway(
         backend,
         panel=panel,
@@ -780,6 +935,7 @@ def _run_serve(argv: list[str]) -> int:
             host=args.host,
             port=args.port,
             admission=AdmissionConfig(
+                priorities=priorities,
                 max_queue=args.queue_bound,
                 max_inflight=args.max_inflight,
                 default_deadline_s=args.default_deadline_s,

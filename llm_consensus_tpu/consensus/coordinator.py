@@ -115,6 +115,15 @@ class CoordinatorConfig:
     retries: int = 1
     # Sampling params used for panel calls unless a persona overrides.
     sampling: SamplingParams = field(default_factory=SamplingParams)
+    # Consensus phase -> model routing (PR 18): with a multi-model
+    # backend (serving.modelset.ModelSetBackend), map
+    # "propose"/"evaluate"/"refine" to member names — propose on the
+    # small proposer, judge/refine on the large — and every request of
+    # that phase carries the mapped model tag (overriding any
+    # per-persona model). None (default) = per-persona models only,
+    # the pre-PR-18 behavior. Phases absent from the map fall back the
+    # same way. ``ModelSet.phase_models()`` builds the canonical map.
+    phase_models: dict[str, str] | None = None
 
 
 @dataclass
@@ -351,7 +360,8 @@ class Coordinator:
         log.debug("Received AskQuestion: %s", question)
         with _phase_span("propose", 0):
             result = await self._call_persona(
-                proposer, answer_prompt(question), required=True
+                proposer, answer_prompt(question), required=True,
+                phase="propose",
             )
         fanout = self.on_answer(
             AnswerQuestion(answer=result.text, author=proposer.name, epoch=epoch)
@@ -368,7 +378,8 @@ class Coordinator:
                     [
                         evaluation_prompt(question, self.answer, p)
                         for p in self.panel
-                    ]
+                    ],
+                    phase="evaluate",
                 )
             refinement_request: tuple[str, RefineAnswer] | None = None
             for persona, text in zip(self.panel, texts):
@@ -395,6 +406,7 @@ class Coordinator:
                         refine_msg.question, refine_msg.answer, refiner
                     ),
                     required=True,
+                    phase="refine",
                 )
             fanout = self.on_refinement(
                 AnswerRefinement(
@@ -450,13 +462,25 @@ class Coordinator:
     def _backend_for(self, persona: Persona) -> Backend:
         return self.backends.get(persona.name, self.backend)
 
+    def _model_for(self, persona: Persona, phase: str | None) -> str | None:
+        """The model tag one phase call carries: the phase-routing map
+        wins (cross-model consensus, PR 18), else the persona's own."""
+        pm = self.config.phase_models
+        if phase is not None and pm:
+            routed = pm.get(phase)
+            if routed is not None:
+                return routed
+        return persona.model
+
     def _params_for(self, persona: Persona) -> SamplingParams:
         base = self.config.sampling
         if persona.temperature is None:
             return base
         return dataclasses.replace(base, temperature=persona.temperature)
 
-    async def _generate_for_panel(self, prompts: list[str]) -> list[str]:
+    async def _generate_for_panel(
+        self, prompts: list[str], phase: str | None = None
+    ) -> list[str]:
         """Batch prompts per backend (heterogeneous panels use several) and
         run the groups concurrently. A failed evaluation degrades to a
         ``NeedsRefinement`` verdict instead of crashing the protocol."""
@@ -471,7 +495,7 @@ class Coordinator:
                 GenerationRequest(
                     prompt=prompt,
                     params=self._params_for(persona),
-                    model=persona.model,
+                    model=self._model_for(persona, phase),
                 )
             )
 
@@ -497,11 +521,17 @@ class Coordinator:
         return texts
 
     async def _call_persona(
-        self, persona: Persona, prompt: str, required: bool
+        self,
+        persona: Persona,
+        prompt: str,
+        required: bool,
+        phase: str | None = None,
     ) -> GenerationResult:
         backend = self._backend_for(persona)
         req = GenerationRequest(
-            prompt=prompt, params=self._params_for(persona), model=persona.model
+            prompt=prompt,
+            params=self._params_for(persona),
+            model=self._model_for(persona, phase),
         )
         try:
             return await self._with_supervision(lambda: backend.generate(req))

@@ -29,6 +29,14 @@ Two rules, per row:
   exact — accept with prob p(d), else resample from the residual
   ``norm(max(p - onehot(d), 0))`` = p conditioned on != d, whose
   marginal is exactly p.
+
+Both rules are exact for ANY one-hot draft proposal, which is what
+makes cross-model speculation (PR 18) a pure transport concern: a
+vocab-remapped draft stream (:mod:`llm_consensus_tpu.serving.
+vocab_align`) changes WHICH tokens get proposed — unmapped ids lift to
+the target pad and are all but guaranteed a rejection — but never the
+distribution of what is emitted. The accept math below needs no
+remap awareness and takes none.
 """
 
 from __future__ import annotations

@@ -895,6 +895,21 @@ class Gateway:
                 log.exception("request_cost failed; using nominal cost")
         return adm_kw
 
+    def _lane_for(self, model: str | None, fallback: str) -> str:
+        """Per-model admission lane (PR 18): when the controller was
+        configured with a ``model:<name>`` priority lane for this
+        request's model tag, default the request there — one member's
+        burst queues behind its own bound instead of starving the
+        panel's other models. An explicit payload ``priority`` always
+        wins (``_admission_kw`` reads it first); unknown models keep
+        the route's base lane and fail later with the backend's
+        unknown-model error, not a KeyError here."""
+        if model:
+            lane = f"model:{model}"
+            if lane in self.admission.config.priorities:
+                return lane
+        return fallback
+
     @staticmethod
     def _admission_kw(payload: dict, default_priority: str) -> dict:
         kw = {"priority": payload.get("priority", default_priority)}
@@ -925,7 +940,10 @@ class Gateway:
                 model=payload.get("model"),
             )
             adm_kw = self._cost_kw(
-                self._admission_kw(payload, "interactive"),
+                self._admission_kw(
+                    payload,
+                    self._lane_for(payload.get("model"), "interactive"),
+                ),
                 prompt,
                 req.params.max_new_tokens,
             )
@@ -1147,12 +1165,32 @@ class Gateway:
             self._count("/v1/consensus", 400)
             return
         try:
+            # Consensus phase -> model routing (PR 18): an explicit
+            # "phase_models" map in the payload wins; otherwise a
+            # multi-model backend's canonical routing (propose on the
+            # draft donor, judge/refine on the default) applies.
+            phase_models = payload.get("phase_models")
+            if phase_models is None:
+                pm_hook = getattr(self.backend, "modelset", None)
+                if pm_hook is not None:
+                    phase_models = pm_hook.phase_models()
+            elif not (
+                isinstance(phase_models, dict)
+                and all(
+                    isinstance(k, str) and isinstance(v, str)
+                    for k, v in phase_models.items()
+                )
+            ):
+                raise ValueError(
+                    "phase_models must map phase names to model names"
+                )
             cfg = CoordinatorConfig(
                 max_rounds=int(
                     payload.get("max_rounds", self.config.max_rounds)
                 ),
                 seed=payload.get("seed", self.config.consensus_seed),
                 sampling=self._sampling_from(payload),
+                phase_models=phase_models,
             )
             adm_kw = self._cost_kw(
                 self._admission_kw(payload, "batch"),

@@ -60,6 +60,10 @@ __all__ = [
     "SPEC_ACCEPTED_TOKENS",
     "SPEC_ACCEPTANCE",
     "SPEC_VERIFIED_TOKENS",
+    "SPEC_XMODEL_ACCEPTED_TOKENS",
+    "SPEC_XMODEL_COVERAGE",
+    "MODEL_REQUESTS",
+    "MODEL_TOKENS",
     "ACCEPTANCE_BUCKETS",
     "TRACE_DROPPED",
     "FLIGHT_DROPPED",
@@ -636,6 +640,34 @@ SPEC_ACCEPTANCE = REGISTRY.histogram(
 SPEC_VERIFIED_TOKENS = REGISTRY.gauge(
     "gateway_spec_verified_tokens",
     "Tokens emitted by the most recent speculative verify program",
+)
+#: Cross-model speculation (PR 18): draft tokens accepted when the
+#: draft rode a vocab-alignment remap (serving/vocab_align.py) — a
+#: DIFFERENT tokenizer than the target's. Counted at the same fetch
+#: site as gateway_spec_accepted_tokens_total (the cross-model counts
+#: are a subset); the coverage gauge is the construction-time
+#: exact-match fraction the pairing engaged with, labeled by the
+#: target ``model`` so a heterogeneous ModelSet's pairings read apart.
+SPEC_XMODEL_ACCEPTED_TOKENS = REGISTRY.counter(
+    "gateway_spec_cross_model_accepted_tokens_total",
+    "Draft tokens accepted through a cross-model vocab remap",
+)
+SPEC_XMODEL_COVERAGE = REGISTRY.gauge(
+    "gateway_spec_cross_model_coverage",
+    "Exact-match vocab coverage of the engaged cross-model draft pairing",
+)
+#: Multi-model serving plane (PR 18, serving/modelset.py): one gateway
+#: fronting N independent engines. Labeled ``model=<member name>`` —
+#: the shared metrics plane's per-model split (requests dispatched to
+#: each member and the tokens it generated), mirrored into
+#: ``ModelSet.stats()`` for the bench.
+MODEL_REQUESTS = REGISTRY.counter(
+    "gateway_model_requests_total",
+    "Requests dispatched to each ModelSet member (label: model)",
+)
+MODEL_TOKENS = REGISTRY.counter(
+    "gateway_model_tokens_total",
+    "Tokens generated by each ModelSet member (label: model)",
 )
 #: Consensus protocol phase latency, labeled
 #: ``phase="propose"|"evaluate"|"refine"`` — one observation per phase

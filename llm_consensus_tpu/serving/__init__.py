@@ -13,6 +13,9 @@ panel fan-outs) enqueue requests; two granularities are offered:
 - :class:`ReplicaSet` — N continuous batchers behind one prefix-
   affinity router with a fleet-shared host page store (PR 14): the
   scale-out layer (``serve --replicas K``).
+- :class:`ModelSet` — N independent ENGINES (distinct models, configs,
+  meshes) behind one gateway (PR 18), with cross-model speculative
+  decoding through a vocab-alignment remap (``serve --models ...``).
 """
 
 from llm_consensus_tpu.serving.continuous import (
@@ -27,7 +30,13 @@ from llm_consensus_tpu.serving.fleet import (
     PrefixRouter,
     ReplicaSet,
 )
+from llm_consensus_tpu.serving.modelset import (
+    ModelSet,
+    ModelSetBackend,
+    ModelSpec,
+)
 from llm_consensus_tpu.serving.offload import HostPageStore
+from llm_consensus_tpu.serving.vocab_align import VocabMap, align_vocabs
 from llm_consensus_tpu.serving.scheduler import (
     BatchScheduler,
     SchedulerConfig,
@@ -42,9 +51,14 @@ __all__ = [
     "FleetBackend",
     "FleetConfig",
     "HostPageStore",
+    "ModelSet",
+    "ModelSetBackend",
+    "ModelSpec",
     "PrefixRouter",
     "ReplicaSet",
     "SchedulerConfig",
     "ServeResult",
     "ServingBackend",
+    "VocabMap",
+    "align_vocabs",
 ]

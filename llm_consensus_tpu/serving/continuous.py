@@ -217,6 +217,9 @@ from llm_consensus_tpu.server.metrics import (
     SPEC_VERIFIED_TOKENS as _M_SPEC_VERIFIED,
 )
 from llm_consensus_tpu.server.metrics import (
+    SPEC_XMODEL_ACCEPTED_TOKENS as _M_SPEC_XMODEL,
+)
+from llm_consensus_tpu.server.metrics import (
     SERVING_ACTIVE as _M_ACTIVE,
 )
 from llm_consensus_tpu.server.metrics import (
@@ -660,6 +663,7 @@ class ContinuousBatcher:
         config: ContinuousConfig | None = None,
         mesh=None,
         draft: tuple[ModelConfig, dict] | None = None,
+        draft_map=None,
         host_store: HostPageStore | None = None,
         host_store_scope: tuple | None = None,
         controller=None,
@@ -690,6 +694,18 @@ class ContinuousBatcher:
         self._draft_cfg: ModelConfig | None = None
         self._draft_params: dict | None = None
         self.draft_cache = None
+        # Cross-model vocab remap (PR 18, serving/vocab_align.py):
+        # ``draft_map`` carries the exact-match d2t/t2d tables when the
+        # draft speaks a DIFFERENT tokenizer. All carried token state —
+        # committed streams, spec_fill, the verify drafts — stays in
+        # TARGET vocab; t2d applies only at the draft model's input
+        # boundary (its decode scan and prefill mirrors), d2t only at
+        # its argmax output. An identity map (or None with equal
+        # vocabs) keeps the PR-9 single-tokenizer fast path: no gather
+        # in any trace.
+        self._vocab_map = draft_map
+        self._t2d = None
+        self._d2t = None
         if draft is not None:
             dcfg, dparams = draft
             if c.spec_k <= 0:
@@ -697,10 +713,26 @@ class ContinuousBatcher:
                     "a draft model needs spec_k > 0 (spec_k sizes the "
                     "page-overshoot budget and the verify program)"
                 )
-            if dcfg.vocab_size != cfg.vocab_size:
+            if draft_map is not None and not draft_map.identity:
+                if len(draft_map.d2t) != dcfg.vocab_size or len(
+                    draft_map.t2d
+                ) != cfg.vocab_size:
+                    raise ValueError(
+                        f"draft_map shape mismatch: d2t[{len(draft_map.d2t)}]"
+                        f" vs draft vocab {dcfg.vocab_size}, t2d"
+                        f"[{len(draft_map.t2d)}] vs target vocab "
+                        f"{cfg.vocab_size}"
+                    )
+                # Tiny int32 tables captured as jit constants — one
+                # device copy, every spec/prefill trace closes over it.
+                self._t2d = jnp.asarray(draft_map.t2d, jnp.int32)
+                self._d2t = jnp.asarray(draft_map.d2t, jnp.int32)
+            elif dcfg.vocab_size != cfg.vocab_size:
                 raise ValueError(
                     f"draft vocab {dcfg.vocab_size} != target vocab "
-                    f"{cfg.vocab_size} — speculation needs one tokenizer"
+                    f"{cfg.vocab_size} — cross-model speculation needs a "
+                    "vocab alignment map (serving.vocab_align."
+                    "align_vocabs) or one shared tokenizer"
                 )
             if c.steps_per_sync > 1:
                 # Not an error: spec_decode is a live lever and the
@@ -849,6 +881,11 @@ class ContinuousBatcher:
         # entries) — so heterogeneous replicas can never cross-restore.
         # A private (per-batcher) store pays the same prefix for free.
         self._store_scope: tuple = ()
+        # Chain-scope doc for /debug/chains (PR 18): which model's
+        # weights wrote the chains this batcher counts. Lazy — the
+        # weights fingerprint walks every param leaf, a cost the first
+        # debug probe pays once, not construction.
+        self._probe_scope: dict | None = None
         if (
             c.host_cache_bytes > 0
             and c.share_prefix
@@ -891,6 +928,12 @@ class ContinuousBatcher:
                         self._draft_cfg.head_dim,
                         _weights_fingerprint(self._draft_params),
                     )
+                    if self._vocab_map is not None:
+                        # The draft planes a restore installs were
+                        # written through THIS remap; a different map
+                        # means different draft inputs for the same
+                        # target chain.
+                        scope += self._vocab_map.scope_key()
                 self._store_scope = scope
             for reg in self._registries:
                 reg.on_evict = self._demote_nodes
@@ -1141,6 +1184,7 @@ class ContinuousBatcher:
         # exactly as engine/speculative.py pins its verify chunk.
         self._spec_drafted = 0
         self._spec_accepted = 0
+        self._spec_xmodel_accepted = 0
         self._spec_shared_rows = 0
         self._spec_acc_sum = 0.0
         self._spec_acc_count = 0
@@ -1588,13 +1632,22 @@ class ContinuousBatcher:
         k = spec_k
         b = tokens.shape[0]
         dcfg = self._draft_cfg
+        # Cross-model remap (PR 18): carried state (tokens, hist,
+        # spec_fill, drafts) is TARGET vocab; the draft model's inputs
+        # gather through t2d and its argmax lifts through d2t. Both
+        # tables are trace constants; the identity case compiles with
+        # no gather at all (self._t2d is None).
+        t2d, d2t = self._t2d, self._d2t
 
         def dbody(carry, j):
             dc, tok, hist = carry
+            din = tok if t2d is None else t2d[tok]
             lg, dc = decode_step_paged(
-                dcfg, dparams, tok[:, None], dc, mesh=self.mesh
+                dcfg, dparams, din[:, None], dc, mesh=self.mesh
             )
             prop = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B]
+            if d2t is not None:
+                prop = d2t[prop]
             hist = hist.at[:, j].set(prop)
             # Next input = each row's stream token j: donor committed
             # fill while j < spec_off, else the donor's proposal
@@ -1783,10 +1836,21 @@ class ContinuousBatcher:
         key = (chunk, s_bucket)
         if key not in self._jit_chunk_d:
             dcfg = self._draft_cfg.moe_pin_for(s_bucket, chunk)
-            self._jit_chunk_d[key] = jax.jit(
-                partial(prefill_chunk_paged, dcfg, mesh=self.mesh),
-                donate_argnums=(4,),
-            )
+            t2d = self._t2d
+
+            def f(params, tokens, table, pos, dcache):
+                # Cross-model remap (PR 18): the chunk arrives in
+                # TARGET ids (the one prompt tokenization both pools
+                # share); the draft model reads its t2d image. The
+                # identity case traces with no gather.
+                if t2d is not None:
+                    tokens = t2d[tokens]
+                return prefill_chunk_paged(
+                    dcfg, params, tokens, table, pos, dcache,
+                    mesh=self.mesh,
+                )
+
+            self._jit_chunk_d[key] = jax.jit(f, donate_argnums=(4,))
         return self._jit_chunk_d[key]
 
     def _prefill_fn_d(self, s_bucket: int):
@@ -1794,8 +1858,13 @@ class ContinuousBatcher:
         ``prefill_chunk=0`` admission path's mirror)."""
         if s_bucket not in self._jit_prefill_d:
             dcfg = self._draft_cfg
+            t2d = self._t2d
 
             def f(params, cache, tokens, length, seq_id):
+                if t2d is not None:
+                    # Cross-model remap (PR 18): target-id prompt, t2d
+                    # image into the draft (see _chunk_fn_d).
+                    tokens = t2d[tokens]
                 dense = KVCache.create(dcfg, 1, s_bucket)
                 _, dense = prefill(dcfg, params, tokens, length[None], dense)
                 cache = write_prefill_kv(
@@ -2066,7 +2135,11 @@ class ContinuousBatcher:
         pg = c.page_size
         usable_full = (len(ids) - 1) // pg
         if usable_full <= 0 or not c.share_prefix:
-            return {"registry_tokens": 0, "host_tokens": 0}
+            return {
+                "registry_tokens": 0,
+                "host_tokens": 0,
+                "scope": self.chain_scope(),
+            }
         chain = tuple(int(t) for t in ids[: usable_full * pg])
         best = (0, 0)
         with self._lock:
@@ -2094,7 +2167,34 @@ class ContinuousBatcher:
                                     break
                                 h += 1
                 best = max(best, (t, h * pg))
-        return {"registry_tokens": best[0], "host_tokens": best[1]}
+        return {
+            "registry_tokens": best[0],
+            "host_tokens": best[1],
+            "scope": self.chain_scope(),
+        }
+
+    def chain_scope(self) -> dict:
+        """WHOSE chains this batcher's probe counts (PR 18): the model
+        name and a weights-fingerprint prefix (plus the draft pairing
+        when one is mounted). A heterogeneous fleet's front tier
+        aggregates residency across members whose caches are mutually
+        unrestorable — without the scope, ``/debug/chains`` counts
+        them as one anonymous pool. Fingerprint computed lazily once:
+        it walks every param leaf (the PR-14 store-key walk), a debug
+        cost the first probe pays, never construction or serving."""
+        if self._probe_scope is None:
+            doc = {
+                "model": self.cfg.name,
+                "weights": _weights_fingerprint(self.params)[1][:12],
+            }
+            if self._draft_cfg is not None:
+                doc["draft_model"] = self._draft_cfg.name
+                if self._vocab_map is not None:
+                    doc["draft_vocab_coverage"] = round(
+                        self._vocab_map.coverage, 4
+                    )
+            self._probe_scope = doc
+        return dict(self._probe_scope)
 
     def load_cost(self) -> float:
         """Modeled outstanding HBM bytes of this replica's admitted
@@ -2609,6 +2709,9 @@ class ContinuousBatcher:
                 # amortization realized.
                 "spec_draft_tokens": self._spec_drafted,
                 "spec_accepted_tokens": self._spec_accepted,
+                "spec_cross_model_accepted_tokens": (
+                    self._spec_xmodel_accepted
+                ),
                 "spec_acceptance_sum": self._spec_acc_sum,
                 "spec_acceptance_count": self._spec_acc_count,
                 "spec_verified_tokens_last": self._spec_verified_last,
@@ -4389,8 +4492,27 @@ class ContinuousBatcher:
                 frac = accepted / (rec.spec_k * len(alive))
                 _M_SPEC_ACCEPTANCE.observe(frac)
                 _M_SPEC_VERIFIED.set(emitted)
+                xmodel = (
+                    self._vocab_map is not None
+                    and not self._vocab_map.identity
+                )
+                if xmodel and accepted > 0:
+                    # Cross-model speculation (PR 18): these accepts
+                    # crossed a tokenizer boundary through the vocab
+                    # remap. The flight event is the bench's "≥ 1
+                    # cross-model accept" witness.
+                    _M_SPEC_XMODEL.inc(accepted)
+                    _flight.flight_recorder().record(
+                        "spec_xmodel_accept",
+                        time.perf_counter(),
+                        accepted=accepted,
+                        rows=len(alive),
+                        spec_k=rec.spec_k,
+                    )
                 with self._lock:
                     self._spec_accepted += accepted
+                    if xmodel:
+                        self._spec_xmodel_accepted += accepted
                     self._spec_acc_sum += frac
                     self._spec_acc_count += 1
                     self._spec_verified_last = emitted

@@ -404,6 +404,7 @@ class ReplicaSet:
         mesh=None,
         meshes: list | None = None,
         draft: tuple[ModelConfig, dict] | None = None,
+        draft_map=None,
         control=None,
         host_store=None,
     ):
@@ -414,6 +415,25 @@ class ReplicaSet:
         )
 
         self.cfg = cfg
+        if isinstance(config, (list, tuple)):
+            # The fleet's whole control surface — live knob flips
+            # (spec_decode, decode_rounds, ragged_attention: the bench
+            # and the adaptive controller flip ONE object between
+            # bursts), role_config derivation, the router's shared
+            # page-size/bucket view, and FleetBackend.request_cost's
+            # replica-0 pricing — assumes every decode/mixed replica
+            # reads the SAME ContinuousConfig instance. A per-replica
+            # list would serve, silently, until the first live flip
+            # reached only replica 0.
+            raise ValueError(
+                "ReplicaSet takes ONE shared ContinuousConfig, not "
+                f"per-replica configs (got {type(config).__name__} of "
+                f"{len(config)}): every decode/mixed replica aliases "
+                "the same live instance so a knob flip (spec_decode, "
+                "decode_rounds, ...) reaches the whole fleet at once. "
+                "For heterogeneous engines, build a serving.modelset."
+                "ModelSet of single-model members instead."
+            )
         self.config = config or ContinuousConfig()
         self.fleet_config = fleet or FleetConfig()
         if self.fleet_config.replicas < 1:
@@ -482,6 +502,7 @@ class ReplicaSet:
                 config=role_config(c, self.roles[i]),
                 mesh=replica_meshes[i],
                 draft=draft,
+                draft_map=draft_map,
                 host_store=self.store,
                 # Replica 0 computes the store-key scope (a walk over
                 # every param leaf); its siblings share the identical
@@ -492,6 +513,21 @@ class ReplicaSet:
             if self.store is not None and scope is None:
                 scope = b._store_scope
             self.batchers.append(b)
+        # Shared-config audit (PR 18): role_config must hand every
+        # decode/mixed replica the SAME live instance (prefill copies
+        # are the one sanctioned divergence — their decode machinery is
+        # pinned off and none of the replaced fields enter the store
+        # scope). A drift here means a live knob flip would reach only
+        # part of the fleet — fail loudly at construction, not at the
+        # first flip.
+        for i, b in enumerate(self.batchers):
+            if self.roles[i] != "prefill" and b.config is not c:
+                raise RuntimeError(
+                    f"replica {i} (role {self.roles[i]!r}) holds a "
+                    "private ContinuousConfig copy — the live-knob-flip "
+                    "contract requires every decode/mixed replica to "
+                    "alias the fleet's one shared instance"
+                )
         self.router = PrefixRouter(
             self.batchers, self.fleet_config, c.page_size, roles=self.roles
         )
@@ -699,7 +735,14 @@ class ReplicaSet:
         for b in self.batchers:
             p = b.prefix_probe(ids)
             best = max(best, (p["registry_tokens"], p["host_tokens"]))
-        return {"registry_tokens": best[0], "host_tokens": best[1]}
+        # One scope for the whole answer (PR 18): the fleet is
+        # homogeneous by the shared-config contract, so replica 0's
+        # model/weights identity names every chain counted above.
+        return {
+            "registry_tokens": best[0],
+            "host_tokens": best[1],
+            "scope": self.batchers[0].chain_scope(),
+        }
 
     def heartbeat(self) -> dict:
         """Aggregate serving-loop liveness: ``alive`` only when EVERY

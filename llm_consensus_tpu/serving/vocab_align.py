@@ -1,0 +1,260 @@
+"""Cross-model vocab alignment for speculative decoding (PR 18).
+
+A draft model speeds decoding only when its proposals land in the
+TARGET model's token space. With one tokenizer the spaces coincide and
+the batcher's draft machinery (PR 9) needs no translation; a
+heterogeneous panel — the paper's point — pairs a small proposer with a
+large judge whose tokenizers may differ. This module builds the
+exact-match remap tables that let the small model's greedy stream feed
+the large model's Leviathan verify anyway:
+
+- ``d2t`` maps each DRAFT vocab id to the target id whose single-token
+  round trip matches it byte-for-byte (decode under the draft
+  tokenizer, re-encode under the target's; accept only if that encodes
+  back to exactly one token which decodes to the same string).
+- ``t2d`` is the inverse view — the id the DRAFT model should be fed
+  when the target commits a token. Target ids without a single-token
+  draft equivalent fall back to the draft's pad id: the draft model
+  sees a blind spot, acceptance drops for that context, correctness
+  does not (the accept rule in :mod:`llm_consensus_tpu.engine.accept`
+  is exact for ANY draft proposal, including a garbage one).
+
+Because the batcher drafts greedily (one-hot q), a remapped draft is
+still just "some proposal" to the verify program — alignment quality
+moves the ACCEPTANCE RATE, never the emitted bytes. That invariant is
+what the cross-model byte-parity test pins.
+
+Coverage below ``min_coverage`` means the pairing would burn a full
+draft plane for near-zero acceptance, so :func:`align_vocabs` returns
+None with a construction warning — the documented disengage, mirroring
+the batcher's other no-silent-disengage warnings.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import numpy as np
+
+from llm_consensus_tpu.engine.tokenizer import Tokenizer
+
+__all__ = ["VocabMap", "align_vocabs"]
+
+log = logging.getLogger(__name__)
+
+# Ids above this are never scanned: exact-match alignment decodes and
+# re-encodes every candidate id on the host at construction, and real
+# tokenizers run 32k-256k ids. The cap bounds startup cost; ids past it
+# simply stay unmapped (they lower coverage, which the threshold then
+# judges). Callers with known-small vocabs (bytes: 259) never hit it.
+_DEFAULT_SCAN_LIMIT = 65536
+
+
+@dataclass(frozen=True)
+class VocabMap:
+    """Exact-match token remap between a draft and a target vocab.
+
+    ``d2t``: int32 [draft_vocab] — target id per draft id (target pad
+    where unmapped). ``t2d``: int32 [target_vocab] — draft id per
+    target id (draft pad where unmapped). ``coverage``: mapped fraction
+    of the scanned draft vocab. ``identity``: the tokenizers agree on
+    every scanned id AND the vocab sizes match — the batcher skips the
+    gather entirely and behaves exactly as the one-tokenizer PR-9 path.
+    """
+
+    d2t: np.ndarray
+    t2d: np.ndarray
+    coverage: float
+    identity: bool
+    n_mapped: int
+
+    def scope_key(self) -> tuple:
+        """Cheap content digest for store-key scoping: two maps that
+        hash differently must never share host-tier entries (the draft
+        planes a restore installs were written through this map)."""
+        if self.identity:
+            return ("vocab_map", "identity", len(self.d2t), len(self.t2d))
+        import hashlib
+
+        h = hashlib.sha1(self.d2t.tobytes())
+        h.update(self.t2d.tobytes())
+        return ("vocab_map", h.hexdigest()[:16], self.n_mapped)
+
+    def sized_to(
+        self,
+        target_vocab: int,
+        draft_vocab: int,
+        *,
+        target_pad: int = 0,
+        draft_pad: int = 0,
+    ) -> "VocabMap":
+        """Copy extended to MODEL-config vocab sizes. Alignment runs in
+        tokenizer space, but model embeddings are commonly padded past
+        the tokenizer (lane tiling), and the batcher gathers with model
+        token ids — so the tables must span the model vocabs. Padded-
+        tail ids are unmapped (-> pad): a random-weight argmax landing
+        there drafts pad and gets rejected, never out-indexes. Identity
+        survives only when both model vocabs already match the tables
+        (equal-size pass-through skips the gather, which is only safe
+        when every representable id means the same thing on both
+        sides)."""
+        if target_vocab < len(self.t2d) or draft_vocab < len(self.d2t):
+            raise ValueError(
+                f"model vocab ({target_vocab} target / {draft_vocab} "
+                f"draft) smaller than the tokenizer tables "
+                f"({len(self.t2d)} / {len(self.d2t)}) — the tokenizer "
+                "emits ids the model cannot embed"
+            )
+        if target_vocab == len(self.t2d) and draft_vocab == len(self.d2t):
+            return self
+        d2t = np.full(draft_vocab, target_pad, dtype=np.int32)
+        t2d = np.full(target_vocab, draft_pad, dtype=np.int32)
+        d2t[: len(self.d2t)] = self.d2t
+        t2d[: len(self.t2d)] = self.t2d
+        identity = self.identity and target_vocab == draft_vocab
+        if identity:
+            # Same tokenizer layout, equal padded vocabs: the tail maps
+            # to itself, matching the PR-9 single-tokenizer pass-through.
+            tail = np.arange(len(self.d2t), draft_vocab, dtype=np.int32)
+            d2t[len(self.d2t) :] = tail
+            t2d[len(self.t2d) :] = tail
+        return VocabMap(
+            d2t=d2t,
+            t2d=t2d,
+            coverage=self.coverage,
+            identity=identity,
+            n_mapped=self.n_mapped,
+        )
+
+
+def _single_token_match(src: Tokenizer, dst: Tokenizer, tid: int):
+    """Target-side id for ``tid`` iff the round trip is exact: decode
+    under ``src``, re-encode under ``dst`` to exactly one id whose own
+    decode reproduces the string. Returns None otherwise."""
+    try:
+        s = src.decode([tid])
+    except Exception:  # noqa: BLE001 - undecodable id = unmapped
+        return None
+    if not s:
+        return None
+    try:
+        out = dst.encode(s, add_bos=False)
+    except Exception:  # noqa: BLE001 - unencodable text = unmapped
+        return None
+    if len(out) != 1:
+        return None
+    try:
+        if dst.decode(out) != s:
+            return None
+    except Exception:  # noqa: BLE001
+        return None
+    return int(out[0])
+
+
+def align_vocabs(
+    target_tok: Tokenizer,
+    draft_tok: Tokenizer,
+    *,
+    min_coverage: float = 0.5,
+    scan_limit: int = _DEFAULT_SCAN_LIMIT,
+) -> VocabMap | None:
+    """Build the exact-match :class:`VocabMap` draft→target, or None
+    (with a warning) when shared-subset coverage is below
+    ``min_coverage`` — the construction-time disengage.
+
+    Special ids (pad/bos/eos) are pinned to their counterparts without
+    a round trip: their decode is typically empty/unstable, but the
+    correspondence is structural. The same tokenizer object (or two
+    byte tokenizers — a closed class with one fixed id layout) short-
+    circuits to the identity map.
+    """
+    vt = int(target_tok.vocab_size)
+    vd = int(draft_tok.vocab_size)
+    d2t = np.full(vd, target_tok.pad_id, dtype=np.int32)
+    t2d = np.full(vt, draft_tok.pad_id, dtype=np.int32)
+
+    same_object = target_tok is draft_tok
+    from llm_consensus_tpu.engine.tokenizer import ByteTokenizer
+
+    both_bytes = isinstance(target_tok, ByteTokenizer) and isinstance(
+        draft_tok, ByteTokenizer
+    )
+    if same_object or both_bytes:
+        n = min(vt, vd)
+        ids = np.arange(n, dtype=np.int32)
+        d2t[:n] = ids
+        t2d[:n] = ids
+        return VocabMap(
+            d2t=d2t,
+            t2d=t2d,
+            coverage=n / max(vd, 1),
+            identity=(vt == vd),
+            n_mapped=n,
+        )
+
+    # Structural specials first — they anchor the map even when their
+    # decode round trip is degenerate.
+    for did, tid in (
+        (draft_tok.pad_id, target_tok.pad_id),
+        (draft_tok.bos_id, target_tok.bos_id),
+        (draft_tok.eos_id, target_tok.eos_id),
+    ):
+        if 0 <= did < vd and 0 <= tid < vt:
+            d2t[did] = tid
+            t2d[tid] = did
+
+    specials_d = {draft_tok.pad_id, draft_tok.bos_id, draft_tok.eos_id}
+    scanned = 0
+    mapped = 0
+    identity = vt == vd
+    limit = min(vd, scan_limit)
+    for did in range(limit):
+        if did in specials_d:
+            continue
+        scanned += 1
+        tid = _single_token_match(draft_tok, target_tok, did)
+        if tid is None:
+            identity = False
+            continue
+        d2t[did] = tid
+        mapped += 1
+        if tid != did:
+            identity = False
+        # First writer wins on the inverse: two draft ids round-
+        # tripping to one target id is a draft-side aliasing quirk;
+        # the earlier (usually canonical) id keeps the slot.
+        if t2d[tid] == draft_tok.pad_id or tid == target_tok.pad_id:
+            t2d[tid] = did
+    if vd > limit:
+        identity = False
+        log.warning(
+            "vocab alignment scanned %d of %d draft ids (scan_limit): "
+            "unscanned ids stay unmapped and count against coverage",
+            limit,
+            vd,
+        )
+
+    coverage = mapped / max(scanned, 1)
+    n_mapped = mapped + len({d for d in specials_d if 0 <= d < vd})
+    if coverage < min_coverage:
+        log.warning(
+            "cross-model speculation DISENGAGED: exact-match vocab "
+            "coverage %.1f%% (mapped %d of %d scanned draft ids) is "
+            "below the %.1f%% threshold — a draft proposing outside "
+            "the shared subset would be rejected nearly every round, "
+            "paying the full draft planes for no speedup. Serving "
+            "continues without a draft for this pairing.",
+            100.0 * coverage,
+            mapped,
+            scanned,
+            100.0 * min_coverage,
+        )
+        return None
+    return VocabMap(
+        d2t=d2t,
+        t2d=t2d,
+        coverage=coverage,
+        identity=identity,
+        n_mapped=n_mapped,
+    )

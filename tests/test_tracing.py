@@ -516,6 +516,8 @@ def test_check_metrics_detects_undeclared_family(tmp_path):
         "llm_consensus_tpu/serving/control.py",
         "llm_consensus_tpu/serving/disagg.py",
         "llm_consensus_tpu/serving/remote_store.py",
+        "llm_consensus_tpu/serving/modelset.py",
+        "llm_consensus_tpu/serving/vocab_align.py",
         "llm_consensus_tpu/server/gateway.py",
         "llm_consensus_tpu/server/admission.py",
         "llm_consensus_tpu/consensus/coordinator.py",
