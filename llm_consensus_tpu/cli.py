@@ -889,7 +889,101 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "cache). The local backend still serves /healthz, /metrics "
         "and debug routes; use --backend fake for a pure front",
     )
+    # Fleet control plane (PR 19).
+    p.add_argument(
+        "--fleet-control",
+        action="store_true",
+        help="fleet control plane (PR 19): run one FleetController "
+        "over the --replicas fleet — SLO-aware admission (requests "
+        "carry an optional 'slo' payload field; at a full queue the "
+        "request that WILL miss its target is shed, never simply the "
+        "newest), tenant weighted fair queueing over the 'tenant' "
+        "field, router load-weight steering from live queue-cost "
+        "signals, group/restore sizing, and elastic replica "
+        "spawn/retire (--elastic-max). Requires --replicas > 1",
+    )
+    p.add_argument(
+        "--slo-target",
+        action="append",
+        default=None,
+        metavar="CLASS=SECONDS",
+        help="fleet control: SLO class -> queue-wait target seconds "
+        "(repeatable; default interactive=2,batch=30). Defines the "
+        "classes the /v1/generate 'slo' payload field accepts",
+    )
+    p.add_argument(
+        "--slo-class",
+        default="interactive",
+        help="fleet control: default SLO class for untagged requests "
+        "('none' = untagged requests stay SLO-blind)",
+    )
+    p.add_argument(
+        "--tenant-weight",
+        action="append",
+        default=None,
+        metavar="TENANT=WEIGHT",
+        help="fleet control: tenant fair-share weight (repeatable; "
+        "unlisted tenants weigh 1.0)",
+    )
+    p.add_argument(
+        "--elastic-max",
+        type=int,
+        default=0,
+        help="fleet control: elastic replica ceiling (0 = fixed "
+        "fleet; above --replicas the controller spawns batchers "
+        "against sustained queue depth and retires them when the "
+        "fleet idles, draining through the shared host tier)",
+    )
     return p
+
+
+def _parse_fleet_control(args):
+    """``serve --fleet-control`` flags -> :class:`FleetControlConfig`
+    (None when the flag is off). Shared by serve and bench."""
+    if not getattr(args, "fleet_control", False):
+        return None
+    from llm_consensus_tpu.serving.fleet_control import FleetControlConfig
+
+    cfg = FleetControlConfig()
+    if args.slo_target:
+        classes = {}
+        for spec in args.slo_target:
+            name, _, secs = spec.partition("=")
+            if not name or not secs:
+                raise SystemExit(
+                    f"--slo-target expects CLASS=SECONDS, got {spec!r}"
+                )
+            classes[name] = float(secs)
+        cfg.slo_classes = classes
+    default = args.slo_class
+    cfg.default_slo_class = None if default in (None, "none", "") else default
+    if (
+        cfg.default_slo_class is not None
+        and cfg.default_slo_class not in cfg.slo_classes
+    ):
+        raise SystemExit(
+            f"--slo-class {cfg.default_slo_class!r} is not one of the "
+            f"--slo-target classes {sorted(cfg.slo_classes)}"
+        )
+    if args.tenant_weight:
+        weights = {}
+        for spec in args.tenant_weight:
+            name, _, w = spec.partition("=")
+            if not name or not w:
+                raise SystemExit(
+                    f"--tenant-weight expects TENANT=WEIGHT, got {spec!r}"
+                )
+            weights[name] = float(w)
+        cfg.tenant_weights = weights
+    if args.elastic_max:
+        cfg.elastic_min = max(1, args.replicas)
+        cfg.elastic_max = args.elastic_max
+        if cfg.elastic_max < cfg.elastic_min:
+            raise SystemExit(
+                f"--elastic-max {cfg.elastic_max} is below "
+                f"--replicas {cfg.elastic_min}"
+            )
+    return cfg
 
 
 def _run_serve(argv: list[str]) -> int:
@@ -918,7 +1012,22 @@ def _run_serve(argv: list[str]) -> int:
         _flight.set_enabled(False)
     _flight.flight_recorder().configure(capacity=args.flight_events)
     panel = load_panel(args.panel) if args.panel else default_panel()
+    fleet_cfg = _parse_fleet_control(args)
     backend = _build_backend(args)
+    # Fleet control plane (PR 19): one controller over the replica
+    # fleet. Its config also seeds the gateway's SLO classes and
+    # tenant weights (admission_kwargs below) so the two layers agree.
+    fleet_controller = None
+    if fleet_cfg is not None:
+        replicas = getattr(backend, "replicas", None)
+        if replicas is None:
+            raise SystemExit(
+                "--fleet-control requires the replica fleet backend "
+                "(--backend continuous --replicas 2+)"
+            )
+        from llm_consensus_tpu.serving.fleet_control import FleetController
+
+        fleet_controller = FleetController(replicas, fleet_cfg)
     # Per-model admission lanes (PR 18): a multi-model backend adds one
     # ``model:<name>`` priority lane per member behind the base pair —
     # a request tagged with a model defaults into its own lane (the
@@ -928,6 +1037,7 @@ def _run_serve(argv: list[str]) -> int:
     modelset = getattr(backend, "modelset", None)
     if modelset is not None and args.model_lanes:
         priorities = priorities + modelset.admission_lanes()
+    admission_kw = fleet_cfg.admission_kwargs() if fleet_cfg else {}
     gateway = Gateway(
         backend,
         panel=panel,
@@ -942,6 +1052,7 @@ def _run_serve(argv: list[str]) -> int:
                 cost_budget_bytes=float(
                     args.admission_cost_budget_mb << 20
                 ),
+                **admission_kw,
             ),
             sampling=SamplingParams(
                 max_new_tokens=args.max_new_tokens,
@@ -965,7 +1076,13 @@ def _run_serve(argv: list[str]) -> int:
                 pass
         await gateway.run_until(stop)
 
-    asyncio.run(_serve())
+    if fleet_controller is not None:
+        fleet_controller.start()
+    try:
+        asyncio.run(_serve())
+    finally:
+        if fleet_controller is not None:
+            fleet_controller.stop()
     return 0
 
 

@@ -54,14 +54,31 @@ __all__ = [
 
 
 class QueueFullError(Exception):
-    """Load shed: the request's priority queue is at its bound."""
+    """Load shed: the request's priority queue is at its bound.
 
-    def __init__(self, priority: str, retry_after: float):
+    ``slo_miss`` marks a deadline-aware shed (PR 19): the victim was
+    chosen because it *will miss its SLO*, not because it was newest.
+    ``tenant_over`` marks a fair-share shed: the tenant exceeded its
+    weighted share of admitted modeled cost while another tenant was
+    waiting. Both ride the exception so the gateway's flight-recorder
+    shed event can name the reason.
+    """
+
+    def __init__(
+        self,
+        priority: str,
+        retry_after: float,
+        *,
+        slo_miss: bool = False,
+        tenant_over: bool = False,
+    ):
         super().__init__(
             f"{priority} queue full; retry after {retry_after:.1f}s"
         )
         self.priority = priority
         self.retry_after = retry_after
+        self.slo_miss = slo_miss
+        self.tenant_over = tenant_over
 
 
 class DrainingError(Exception):
@@ -109,6 +126,41 @@ class AdmissionConfig:
     # (budget / bound_for(priority)). 0 (default) = classic
     # request-count bounds.
     cost_budget_bytes: float = 0.0
+    # SLO classes (PR 19): class name -> queue-wait target in seconds
+    # (the admission-controlled component of TTFT — the PR-10 TTFT/TBT
+    # histograms become targets instead of telemetry). A request names
+    # a class via the ``/v1/generate`` ``"slo"`` payload field; unknown
+    # names are a 400 at the door. None = SLO-blind admission.
+    slo_classes: dict[str, float] | None = None
+    # Class applied to requests that carry no ``"slo"`` field. None =
+    # untagged requests stay SLO-blind even when classes are defined.
+    default_slo_class: str | None = None
+    # Tenant fair-share (PR 19): True enables weighted fair queueing
+    # across the ``"tenant"`` payload field — WFQ dispatch order within
+    # a priority plus an admitted-cost share cap under contention, so
+    # one tenant's storm cannot starve panel traffic. Enforcement uses
+    # the same modeled-byte unit as cost-budget admission.
+    tenant_fair_share: bool = False
+    # Tenant -> WFQ weight. Tenants absent from the map weigh 1.0, so
+    # an empty map means equal shares.
+    tenant_weights: dict[str, float] | None = None
+    # Share-cap slack: a tenant is shed at the door only once its
+    # decayed admitted-cost share exceeds fair_weight * slack while
+    # another tenant has queued work (1.1 = the ±10% band the fleet
+    # bench gates on).
+    fair_share_slack: float = 1.1
+    # Half-life in seconds of the decayed per-tenant admitted-cost
+    # window the share cap is computed over.
+    fair_window_s: float = 30.0
+
+    def slo_target(self, name: str | None) -> float | None:
+        if name is None or not self.slo_classes:
+            return None
+        return self.slo_classes.get(name)
+
+    def tenant_weight(self, tenant: str) -> float:
+        w = (self.tenant_weights or {}).get(tenant, 1.0)
+        return max(float(w), 1e-6)
 
     def bound_for(self, priority: str) -> int:
         if isinstance(self.max_queue, dict):
@@ -132,6 +184,18 @@ class _Item:
     # priority's queue-cost account while queued, released at dispatch
     # or expiry. 0 in classic request-count mode.
     cost: float = 0.0
+    # SLO class + queue-wait target (PR 19); None = SLO-blind request.
+    slo_class: str | None = None
+    slo_target: float | None = None
+    # Tenant the request bills to (PR 19 fair-share); None = untagged.
+    tenant: str | None = None
+    # WFQ finish tag stamped at admission when fair-share is on; the
+    # dispatcher picks the smallest tag within a priority. 0 = untagged
+    # (dispatches ahead of tagged work — it is outside fair-share).
+    wfq_tag: float = 0.0
+    # Work units for rate/fairness accounting: modeled bytes in
+    # cost-budget mode, 1.0 per request in classic mode.
+    units: float = 1.0
 
 
 class AdmissionController:
@@ -209,6 +273,51 @@ class AdmissionController:
             "gateway_queue_cost_bytes",
             "Modeled bytes waiting for admission (cost-budget mode)",
         )
+        # -- PR 19 SLO / tenant families + their stats() mirrors. The
+        # mirrors are incremented in the same statement block as the
+        # Prometheus family so the lockstep tests can cross-check.
+        self._m_slo_miss = reg.counter(
+            "gateway_slo_miss_total",
+            "Requests whose queue wait exceeded their SLO class target",
+        )
+        self._m_slo_shed = reg.counter(
+            "gateway_slo_shed_total",
+            "Deadline-aware sheds of requests that would miss their SLO",
+        )
+        self._m_headroom = reg.histogram(
+            "gateway_slo_headroom_seconds",
+            "Predicted SLO slack at admission (target - estimated wait)",
+        )
+        self._m_tenant_cost = reg.counter(
+            "gateway_tenant_cost_bytes",
+            "Admitted modeled cost per tenant (bytes in cost-budget "
+            "mode, request units otherwise)",
+        )
+        self._m_tenant_shed = reg.counter(
+            "gateway_tenant_shed_total",
+            "Fair-share sheds: tenant over its weighted admitted share",
+        )
+        self._slo_missed: dict[str, int] = {}
+        self._slo_sheds = 0
+        self._headroom_sum = 0.0
+        self._headroom_count = 0
+        self._tenant_admitted: dict[str, float] = {}
+        self._tenant_sheds: dict[str, int] = {}
+        # Queued-request count per tenant (all lanes): the contention
+        # signal for the share cap — a tenant is capped only while
+        # someone ELSE is waiting.
+        self._tenant_queued: dict[str, int] = {}
+        # Decayed admitted-units window per tenant (half-life
+        # fair_window_s) the share cap compares against weights.
+        self._tenant_recent: dict[str, float] = {}
+        self._recent_mark = time.monotonic()
+        # WFQ virtual time: per-tenant last finish tag + global floor.
+        self._vt: dict[str, float] = {}
+        self._vtime = 0.0
+        # Dispatch-rate EWMA in units/s — the queue-drain model behind
+        # predicted waits and would-miss selection.
+        self._rate: float | None = None
+        self._rate_mark: float | None = None
 
     # -- admission ------------------------------------------------------
 
@@ -227,6 +336,8 @@ class AdmissionController:
         priority: str | None = None,
         deadline_s: float | None = None,
         cost: float | None = None,
+        slo: str | None = None,
+        tenant: str | None = None,
     ):
         """Admit ``thunk`` and await its terminal outcome.
 
@@ -243,6 +354,19 @@ class AdmissionController:
         compare in modeled bytes; a costless submit is priced at one
         nominal slot (budget / bound) so legacy callers keep
         approximately the classic depth bound.
+
+        ``slo`` (PR 19): SLO class name from ``AdmissionConfig.
+        slo_classes`` (unknown -> ValueError -> the gateway's 400);
+        None falls back to ``default_slo_class``. At a full queue the
+        shed victim is the request that *will miss its SLO* — predicted
+        from modeled cost ahead of it and the live dispatch rate —
+        never simply the newest arrival.
+
+        ``tenant`` (PR 19): fair-share billing key. With
+        ``tenant_fair_share`` on, dispatch within a priority follows
+        weighted-fair-queueing finish tags, and a tenant whose decayed
+        admitted-cost share exceeds its fair weight is shed at the door
+        while another tenant has queued work.
         """
         prio = priority or self.config.priorities[0]
         q = self._queues.get(prio)
@@ -252,6 +376,16 @@ class AdmissionController:
             )
         if self._draining:
             raise DrainingError("gateway is draining; not admitting")
+        if slo is None:
+            slo = self.config.default_slo_class
+        slo_target = self.config.slo_target(slo)
+        if slo is not None and self.config.slo_classes and slo_target is None:
+            raise ValueError(
+                f"unknown slo class {slo!r}; "
+                f"have {sorted(self.config.slo_classes)}"
+            )
+        if slo_target is None:
+            slo = None
         bound = self.config.bound_for(prio)
         budget = self.config.cost_budget_bytes
         factor = self.config.max_overflow_factor
@@ -266,13 +400,32 @@ class AdmissionController:
             # gateway.
             if cost is None or cost <= 0:
                 cost = budget / max(1, bound)
+            units = cost
             queued = self._queue_cost[prio]
             over = len(q) > 0 and queued + cost > budget
             capped = len(q) > 0 and queued + cost > budget * factor
         else:
             cost = 0.0
+            units = 1.0
             over = len(q) >= bound
             capped = len(q) >= bound * factor
+        now = time.monotonic()
+        fair = self.config.tenant_fair_share and tenant is not None
+        if fair:
+            self._decay_recent(now)
+            if len(q) > 0 and self._tenant_over_share(tenant, units):
+                # Fair-share shed: this tenant is past its weighted
+                # share of the admitted-cost window while another
+                # tenant waits. The overflow hook is NOT consulted —
+                # preempting backend capacity cannot fix unfairness.
+                self._m_shed.labels(priority=prio).inc()
+                self._m_tenant_shed.labels(tenant=tenant).inc()
+                self._tenant_sheds[tenant] = (
+                    self._tenant_sheds.get(tenant, 0) + 1
+                )
+                raise QueueFullError(
+                    prio, self._retry_after_hint(), tenant_over=True
+                )
         if over:
             hook = self.overflow_hook
             preempted = False
@@ -281,12 +434,23 @@ class AdmissionController:
                     preempted = bool(hook())
                 except Exception:  # noqa: BLE001 - hook must not 500
                     log.exception("admission overflow hook failed")
-            if not preempted:
+            if not preempted and not self._shed_would_miss(
+                prio, q, now, slo, slo_target, units
+            ):
+                # Classic shed: nobody queued is predicted to miss
+                # worse than the newcomer (or SLO admission is off).
                 self._m_shed.labels(priority=prio).inc()
-                raise QueueFullError(prio, self._retry_after_hint())
+                miss = False
+                if slo_target is not None:
+                    est = self._est_wait(self._units_ahead(prio))
+                    miss = est > slo_target
+                    if miss:
+                        self._count_slo_shed(slo)
+                raise QueueFullError(
+                    prio, self._retry_after_hint(), slo_miss=miss
+                )
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
-        now = time.monotonic()
         item = _Item(
             thunk=thunk,
             priority=prio,
@@ -294,7 +458,37 @@ class AdmissionController:
             enqueued_at=now,
             trace=_tracing.current_trace(),
             cost=cost,
+            slo_class=slo,
+            slo_target=slo_target,
+            tenant=tenant,
+            units=units,
         )
+        if slo_target is not None:
+            # Predicted slack at the door: target minus the modeled
+            # wait behind everything already queued at >= priority.
+            headroom = slo_target - self._est_wait(self._units_ahead(prio))
+            self._m_headroom.observe(headroom)
+            self._headroom_sum += headroom
+            self._headroom_count += 1
+        if fair:
+            # WFQ finish tag: service start is the later of the global
+            # virtual time and the tenant's own last finish, so an idle
+            # tenant re-enters at the current front instead of owing
+            # phantom debt (or banking phantom credit).
+            start = max(self._vtime, self._vt.get(tenant, 0.0))
+            item.wfq_tag = start + units / self.config.tenant_weight(tenant)
+            self._vt[tenant] = item.wfq_tag
+        if tenant is not None:
+            self._tenant_admitted[tenant] = (
+                self._tenant_admitted.get(tenant, 0.0) + units
+            )
+            self._m_tenant_cost.labels(tenant=tenant).inc(units)
+            self._tenant_recent[tenant] = (
+                self._tenant_recent.get(tenant, 0.0) + units
+            )
+            self._tenant_queued[tenant] = (
+                self._tenant_queued.get(tenant, 0) + 1
+            )
         q.append(item)
         self._queue_cost[prio] += item.cost
         self._m_admitted.labels(priority=prio).inc()
@@ -310,6 +504,159 @@ class AdmissionController:
                 deadline_s, self._work.set
             )
         return await item.future
+
+    # -- PR 19 SLO / tenant machinery -----------------------------------
+
+    def _est_wait(self, ahead_units: float) -> float:
+        """Predicted queue wait behind ``ahead_units`` of work, from the
+        dispatch-rate EWMA; falls back to the historical mean wait while
+        the rate model is cold, then to zero on a fresh controller."""
+        if self._rate is not None and self._rate > 1e-9:
+            return ahead_units / self._rate
+        h = self._m_wait
+        if h.count:
+            return h.sum / h.count
+        return 0.0
+
+    def _units_ahead(self, prio: str) -> float:
+        """Work units queued at ``prio`` and every higher priority —
+        what a new arrival at ``prio``'s tail drains behind."""
+        total = 0.0
+        for p in self.config.priorities:
+            for it in self._queues[p]:
+                total += it.units
+            if p == prio:
+                break
+        return total
+
+    def _count_slo_shed(self, cls: str | None) -> None:
+        label = cls or "default"
+        self._m_slo_shed.labels(**{"class": label}).inc()
+        self._m_slo_miss.labels(**{"class": label}).inc()
+        self._slo_sheds += 1
+        self._slo_missed[label] = self._slo_missed.get(label, 0) + 1
+
+    def _shed_would_miss(
+        self,
+        prio: str,
+        q: deque[_Item],
+        now: float,
+        slo: str | None,
+        slo_target: float | None,
+        units: float,
+    ) -> bool:
+        """Deadline-aware victim selection at a full queue: walk the
+        lane computing each queued request's predicted SLO slack
+        (target - waited - modeled wait for its position) and compare
+        against the newcomer's. If a QUEUED request is more doomed than
+        the newcomer, shed IT and admit the newcomer — returns True and
+        the caller skips the classic newest-arrival shed. Requests
+        without an SLO class are never victimized."""
+        if not self.config.slo_classes:
+            return False
+        ahead = 0.0
+        for p in self.config.priorities:
+            if p == prio:
+                break
+            for it in self._queues[p]:
+                ahead += it.units
+        worst_idx = -1
+        worst_slack = (
+            slo_target - self._est_wait(self._units_ahead(prio))
+            if slo_target is not None
+            else float("inf")
+        )
+        run = ahead
+        for i, it in enumerate(q):
+            if it.slo_target is not None and not it.future.done():
+                slack = (
+                    it.slo_target
+                    - (now - it.enqueued_at)
+                    - self._est_wait(run)
+                )
+                if slack < worst_slack:
+                    worst_slack = slack
+                    worst_idx = i
+            run += it.units
+        if worst_idx < 0:
+            return False
+        victim = q[worst_idx]
+        del q[worst_idx]
+        self._release_cost(victim)
+        self._m_depth.labels(priority=prio).set(len(q))
+        self._m_shed.labels(priority=prio).inc()
+        self._count_slo_shed(victim.slo_class)
+        # The victim WAS admitted, so its terminal outcome must land in
+        # the completed account like every other queue exit.
+        self._m_completed.labels(priority=victim.priority).inc()
+        if not victim.future.done():
+            victim.future.set_exception(
+                QueueFullError(
+                    victim.priority,
+                    self._retry_after_hint(),
+                    slo_miss=True,
+                )
+            )
+        self._maybe_idle()
+        return True
+
+    def _decay_recent(self, now: float) -> None:
+        """Age the per-tenant admitted-cost window (half-life
+        ``fair_window_s``) so the share cap reflects current pressure,
+        not all-time history."""
+        dt = now - self._recent_mark
+        if dt <= 0:
+            return
+        self._recent_mark = now
+        w = self.config.fair_window_s
+        if w <= 0:
+            return
+        f = 0.5 ** (dt / w)
+        for t in list(self._tenant_recent):
+            v = self._tenant_recent[t] * f
+            if v < 1e-9:
+                del self._tenant_recent[t]
+            else:
+                self._tenant_recent[t] = v
+
+    def _tenant_over_share(self, tenant: str, units: float) -> bool:
+        """True when admitting ``units`` would push ``tenant`` past its
+        weighted share of the decayed admitted-cost window while some
+        OTHER tenant has queued work. With no contention the cap is
+        inert — fair share is work-conserving, spare capacity flows to
+        whoever offers load."""
+        others = [
+            t
+            for t, n in self._tenant_queued.items()
+            if n > 0 and t != tenant
+        ]
+        if not others:
+            return False
+        active = set(others)
+        active.add(tenant)
+        wsum = sum(self.config.tenant_weight(t) for t in active)
+        fair = self.config.tenant_weight(tenant) / max(wsum, 1e-9)
+        mine = self._tenant_recent.get(tenant, 0.0) + units
+        total = (
+            sum(self._tenant_recent.get(t, 0.0) for t in active) + units
+        )
+        share = mine / max(total, 1e-9)
+        return share > fair * self.config.fair_share_slack
+
+    def stats(self) -> dict:
+        """Mirror of the PR-19 SLO/tenant counters for lockstep checks
+        against the Prometheus families (same increments, same units)."""
+        return {
+            "slo_miss": dict(self._slo_missed),
+            "slo_sheds": self._slo_sheds,
+            "slo_headroom_sum": self._headroom_sum,
+            "slo_headroom_count": self._headroom_count,
+            "tenant_cost_bytes": dict(self._tenant_admitted),
+            "tenant_sheds": dict(self._tenant_sheds),
+            "tenant_queued": {
+                t: n for t, n in self._tenant_queued.items() if n
+            },
+        }
 
     def _retry_after_hint(self) -> float:
         """Shed hint: recent mean queue wait, else the configured floor."""
@@ -328,14 +675,26 @@ class AdmissionController:
 
     def _next_item(self) -> _Item | None:
         """Pop the next runnable item in strict priority order, resolving
-        any already-expired queued items along the way."""
+        any already-expired queued items along the way. With tenant
+        fair-share on, the pick within a priority is the smallest WFQ
+        finish tag instead of FIFO — that interleaving is what bounds a
+        quiet tenant's wait under another tenant's storm."""
         now = time.monotonic()
+        fair = self.config.tenant_fair_share
         for prio in self.config.priorities:
             q = self._queues[prio]
             while q:
-                item = q.popleft()
+                idx = 0
+                if fair and len(q) > 1:
+                    for i in range(1, len(q)):
+                        if q[i].wfq_tag < q[idx].wfq_tag:
+                            idx = i
+                item = q[idx]
+                del q[idx]
                 self._release_cost(item)
                 self._m_depth.labels(priority=prio).set(len(q))
+                if item.wfq_tag:
+                    self._vtime = max(self._vtime, item.wfq_tag)
                 if item.future.done():
                     # Caller gave up while queued (e.g. an aborted SSE
                     # client cancelled its submit): terminal already —
@@ -350,14 +709,20 @@ class AdmissionController:
         return None
 
     def _release_cost(self, item: _Item) -> None:
-        """Release a dequeued item's modeled-cost charge (every
-        popleft site calls this exactly once — the account mirrors
-        queue membership, nothing else)."""
+        """Release a dequeued item's modeled-cost charge and its
+        tenant's queued-count (every dequeue site calls this exactly
+        once — the accounts mirror queue membership, nothing else)."""
         if item.cost:
             c = self._queue_cost[item.priority] = max(
                 0.0, self._queue_cost[item.priority] - item.cost
             )
             self._m_cost.labels(priority=item.priority).set(c)
+        if item.tenant is not None:
+            n = self._tenant_queued.get(item.tenant, 0)
+            if n > 1:
+                self._tenant_queued[item.tenant] = n - 1
+            else:
+                self._tenant_queued.pop(item.tenant, None)
 
     def _expire(self, item: _Item) -> None:
         self._m_expired.labels(priority=item.priority).inc()
@@ -400,8 +765,31 @@ class AdmissionController:
                 await self._work.wait()
                 self._work.clear()
                 continue
-            wait = time.monotonic() - item.enqueued_at
+            now = time.monotonic()
+            wait = now - item.enqueued_at
             self._m_wait.observe(wait)
+            # Dispatch-rate EWMA (units/s): the live drain model the
+            # SLO headroom predictions divide by. Updated only while
+            # work was actually waiting — idle gaps would read as a
+            # collapsed rate.
+            if self._rate_mark is not None and wait > 1e-3:
+                dt = max(now - self._rate_mark, 1e-6)
+                inst = item.units / dt
+                self._rate = (
+                    inst
+                    if self._rate is None
+                    else 0.2 * inst + 0.8 * self._rate
+                )
+            self._rate_mark = now
+            if item.slo_target is not None and wait > item.slo_target:
+                # The PR-10 wait histogram is now a TARGET: a dispatch
+                # past its class budget is a recorded miss, in both the
+                # Prometheus family and the stats() mirror.
+                label = item.slo_class or "default"
+                self._m_slo_miss.labels(**{"class": label}).inc()
+                self._slo_missed[label] = (
+                    self._slo_missed.get(label, 0) + 1
+                )
             if item.trace is not None:
                 # The admission wait, recorded at dispatch (start
                 # reconstructed in the trace's clock).

@@ -409,15 +409,38 @@ class Gateway:
         # is ANDed, the age is the stalest loop's); here we NAME the
         # wedged indices so the operator knows which replica to
         # restart — the router has already stopped sending it traffic.
+        # Elastic lifecycle (PR 19): a DRAINING replica is deliberately
+        # finishing its in-flight work while the router skips it, and a
+        # RETIRED replica's loop is deliberately stopped — neither is
+        # wedged, and neither may flip readiness. They are surfaced
+        # under their own keys so the operator sees the drain progress.
+        replicas = hb.get("replicas") or []
+        draining = [
+            i
+            for i, r in enumerate(replicas)
+            if r.get("state") == "draining"
+        ]
+        retired = [
+            i
+            for i, r in enumerate(replicas)
+            if r.get("state") == "retired"
+        ]
         wedged = [
             i
-            for i, r in enumerate(hb.get("replicas") or [])
-            if not r.get("alive")
-            or (
-                r.get("last_tick_age_s") is not None
-                and r["last_tick_age_s"] > self.config.ready_stall_s
+            for i, r in enumerate(replicas)
+            if r.get("state", "serving") == "serving"
+            and (
+                not r.get("alive")
+                or (
+                    r.get("last_tick_age_s") is not None
+                    and r["last_tick_age_s"] > self.config.ready_stall_s
+                )
             )
         ]
+        if draining:
+            doc = {**doc, "draining_replicas": draining}
+        if retired:
+            doc = {**doc, "retired_replicas": retired}
         if wedged:
             doc = {**doc, "wedged_replicas": wedged}
         if hb.get("alive") is False:
@@ -797,7 +820,20 @@ class Gateway:
             if trace is not None:
                 trace.finish()
 
-    def _record_shed(self, route: str, trace) -> None:
+    @staticmethod
+    def _shed_reason(e: Exception) -> str:
+        """Flight-event reason for a shed (PR 19): ``slo`` = deadline-
+        aware shed of a would-miss request, ``tenant`` = fair-share cap,
+        ``draining`` = SIGTERM drain, else the classic ``queue_full``."""
+        if isinstance(e, DrainingError):
+            return "draining"
+        if getattr(e, "slo_miss", False):
+            return "slo"
+        if getattr(e, "tenant_over", False):
+            return "tenant"
+        return "queue_full"
+
+    def _record_shed(self, route: str, trace, reason: str = "queue_full") -> None:
         """Mirror an admission shed into the flight recorder (PR 10):
         the timeline's counterpart of the 429/503 the client saw.
 
@@ -819,6 +855,7 @@ class Gateway:
                 time.perf_counter(),
                 trace_id=_tracing.trace_id_of(trace),
                 route=route,
+                reason=reason,
             )
         except Exception:  # noqa: BLE001 - recording must never 500
             log.exception("flight shed record failed")
@@ -910,8 +947,7 @@ class Gateway:
                 return lane
         return fallback
 
-    @staticmethod
-    def _admission_kw(payload: dict, default_priority: str) -> dict:
+    def _admission_kw(self, payload: dict, default_priority: str) -> dict:
         kw = {"priority": payload.get("priority", default_priority)}
         if payload.get("deadline_s") is not None:
             d = float(payload["deadline_s"])
@@ -921,6 +957,21 @@ class Gateway:
             if not math.isfinite(d):
                 raise ValueError(f"deadline_s must be finite, got {d}")
             kw["deadline_s"] = d
+        # SLO class + tenant (PR 19): validated HERE, at the 400
+        # boundary, so a typo'd class never reaches admission as a 500.
+        if payload.get("slo") is not None:
+            s = payload["slo"]
+            classes = self.admission.config.slo_classes or {}
+            if not isinstance(s, str) or s not in classes:
+                raise ValueError(
+                    f"unknown slo class {s!r}; have {sorted(classes)}"
+                )
+            kw["slo"] = s
+        if payload.get("tenant") is not None:
+            t = payload["tenant"]
+            if not isinstance(t, str) or not t:
+                raise ValueError("tenant must be a non-empty string")
+            kw["tenant"] = t
         return kw
 
     async def _handle_generate(self, payload: dict, headers, writer) -> None:
@@ -1003,7 +1054,9 @@ class Gateway:
         except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
             status, doc, hdrs = self._error_response(e)
             if isinstance(e, (QueueFullError, DrainingError)):
-                self._record_shed("/v1/generate", trace)
+                self._record_shed(
+                    "/v1/generate", trace, self._shed_reason(e)
+                )
                 if trace is not None:
                     _tracing.trace_store().discard(trace.trace_id)
             await self._respond_json(writer, status, doc, hdrs)
@@ -1102,7 +1155,9 @@ class Gateway:
                 # Same discard the buffered paths apply: a shed stream
                 # did no work, and a 429 storm must not churn the ring.
                 trace = _tracing.current_trace()
-                self._record_shed("/v1/generate", trace)
+                self._record_shed(
+                    "/v1/generate", trace, self._shed_reason(e)
+                )
                 if trace is not None:
                     _tracing.trace_store().discard(trace.trace_id)
             if headers_sent:
@@ -1223,7 +1278,9 @@ class Gateway:
         except Exception as e:  # noqa: BLE001 - mapped to HTTP statuses
             status, doc, hdrs = self._error_response(e)
             if isinstance(e, (QueueFullError, DrainingError)):
-                self._record_shed("/v1/consensus", trace)
+                self._record_shed(
+                    "/v1/consensus", trace, self._shed_reason(e)
+                )
                 if trace is not None:
                     _tracing.trace_store().discard(trace.trace_id)
             await self._respond_json(writer, status, doc, hdrs)

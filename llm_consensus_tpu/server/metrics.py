@@ -926,6 +926,50 @@ HANDOFF_SECONDS = REGISTRY.histogram(
 
 
 # ---------------------------------------------------------------------------
+# Fleet control plane (PR 19, serving/fleet_control.py). The controller
+# is fleet-scoped — one per ReplicaSet — so its families are process-
+# global like the PR-16/17 plane families above. Per-request SLO/tenant
+# admission families live on the gateway's per-instance registry and are
+# manifested in INSTANCE_FAMILIES below.
+# ---------------------------------------------------------------------------
+
+#: Replica lifecycle census, labeled ``state="serving"|"draining"|
+#: "retired"``. Refreshed by ReplicaSet on every state transition; a
+#: nonzero ``draining`` means an elastic retire is mid-drain (the router
+#: skips that replica for new work while its in-flight requests finish).
+FLEET_REPLICAS = REGISTRY.gauge(
+    "gateway_fleet_replicas",
+    "Batcher replicas per lifecycle state",
+)
+#: Elastic lifecycle transitions, labeled ``action="spawn"|"drain"|
+#: "retire"``. A retire is always preceded by a drain (router stops new
+#: work, in-flight finishes, chains demote to the shared HostPageStore)
+#: so ``retire`` without a matching ``drain`` indicates a bug.
+FLEET_SCALE = REGISTRY.counter(
+    "gateway_fleet_scale_total",
+    "Elastic replica lifecycle transitions by action",
+)
+#: Router load-steering weight per replica, labeled ``replica=``. The
+#: fleet controller multiplies each replica's modeled queue cost by this
+#: weight inside PrefixRouter's least-cost comparisons, so weight > 1
+#: repels new work and weight < 1 attracts it. 1.0 = neutral (the
+#: static PR-14 behavior).
+ROUTER_WEIGHT = REGISTRY.gauge(
+    "gateway_router_weight",
+    "PrefixRouter load-steering weight per replica",
+)
+#: Fleet-controller decisions that CHANGED a setpoint, labeled
+#: ``decision="router_weights"|"group_cap"|"restore_cap"|"spawn"|
+#: "retire"``. Mirrors the PR-15 autotune convention: gauges refresh
+#: every tick, this counter moves only on change, and each change also
+#: lands a ``fleet`` flight-recorder event for replay.
+FLEET_DECISIONS = REGISTRY.counter(
+    "gateway_fleet_decisions_total",
+    "Fleet-controller setpoint changes by decision",
+)
+
+
+# ---------------------------------------------------------------------------
 # Canonical manifest of families created on PER-INSTANCE registries
 # (gateway/admission accept an isolated MetricsRegistry for test
 # isolation, so their families cannot be module-level objects here).
@@ -946,6 +990,11 @@ INSTANCE_FAMILIES: dict[str, str] = {
     "gateway_completed_total": "counter",
     "gateway_queue_wait_seconds": "histogram",
     "gateway_queue_cost_bytes": "gauge",
+    "gateway_slo_miss_total": "counter",
+    "gateway_slo_shed_total": "counter",
+    "gateway_slo_headroom_seconds": "histogram",
+    "gateway_tenant_cost_bytes": "counter",
+    "gateway_tenant_shed_total": "counter",
 }
 
 

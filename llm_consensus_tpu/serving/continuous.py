@@ -953,6 +953,12 @@ class ContinuousBatcher:
         # the worker's dispatch-time buffer donation.
         self._preempt_req = 0
         self._preempted_pages = 0
+        # Fleet-steered group-formation cap (PR 19): the fleet
+        # controller resizes GroupTracker.max_groups from fleet-level
+        # sharing pressure. The tracker is worker-owned state, so the
+        # resize is an enqueued REQUEST applied at the top of the
+        # worker loop, exactly like preempts. None = no change pending.
+        self._group_cap_req: int | None = None
         # Export queue entries are mutable [ids, done, stream_until,
         # spilled_pages]: a STREAMED export (PR 17) re-arms itself
         # after each spill until the chain's usable pages are all out
@@ -2106,8 +2112,14 @@ class ContinuousBatcher:
         device call, deadlock) — the gateway's ``/readyz`` probe flips
         to 503 past its stall threshold."""
         now = time.monotonic()
+        alive = self._thread.is_alive() and not self._stop.is_set()
         return {
-            "alive": self._thread.is_alive() and not self._stop.is_set(),
+            "alive": alive,
+            # Lifecycle state (PR 19): a standalone batcher is simply
+            # serving or stopped; a fleet overlays "draining"/"retired"
+            # on its replicas during elastic scale-down so /readyz can
+            # tell a deliberate drain from a wedged loop.
+            "state": "serving" if alive else "stopped",
             "last_tick_age_s": now - self._hb_tick,
             "last_step_age_s": (
                 now - self._hb_step if self._hb_step is not None else None
@@ -2317,6 +2329,34 @@ class ContinuousBatcher:
             self._preempt_req = max(self._preempt_req, int(n_pages))
         self._work.set()
 
+    def request_group_cap(self, n: int) -> None:
+        """Ask the worker to resize the shared-prefix group-formation
+        cap (``GroupTracker.max_groups`` — how many prefix groups the
+        grouped decode program batches per dispatch) at its next
+        iteration. The fleet controller (PR 19) sizes this from
+        fleet-level sharing pressure; the tracker itself is
+        worker-owned, so the change rides the same enqueued-request
+        discipline as preempts. Clamped to [1, max_slots]."""
+        n = max(1, min(int(n), self.config.max_slots))
+        with self._lock:
+            self._group_cap_req = n
+        self._work.set()
+
+    def group_cap(self) -> int:
+        """Current shared-prefix group-formation cap (steered value
+        once a ``request_group_cap`` has been applied)."""
+        return int(self._groups.max_groups)
+
+    def active_requests(self) -> int:
+        """Admitted-but-unfinished requests on this batcher: waiting +
+        slotted. The elastic-retire drain barrier — a draining replica
+        is closeable once this reaches zero (cheap: two length reads
+        under the admission lock)."""
+        with self._lock:
+            return len(self._waiting) + sum(
+                1 for s in self._slots if s is not None
+            )
+
     def request_export(
         self, ids, stream_until: float | None = None
     ) -> threading.Event:
@@ -2482,6 +2522,18 @@ class ContinuousBatcher:
                 "prefetch_expired_pages": self._prefetch_expired,
                 "prefetch_staged_pages": len(self._prefetched),
             }
+
+    def _steer_step(self) -> None:
+        """Worker-side application of a queued group-cap resize (PR
+        19). The tracker re-forms its group view lazily, so the new
+        cap takes effect at the next grouped-decode array build."""
+        if self._group_cap_req is None:
+            return
+        with self._lock:
+            n, self._group_cap_req = self._group_cap_req, None
+        if n is not None and n != self._groups.max_groups:
+            self._groups.max_groups = n
+            self._groups._dirty = True
 
     def _preempt_step(self) -> None:
         """Worker-side execution of queued preempt requests: one
@@ -4624,6 +4676,7 @@ class ContinuousBatcher:
             self._hb_tick = time.monotonic()
             # Fleet requests first (PR 14): preemption frees pages the
             # admission below may need; exports are bounded spills.
+            self._steer_step()
             self._preempt_step()
             self._export_step()
             self._admit()

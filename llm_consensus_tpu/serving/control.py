@@ -299,6 +299,9 @@ class AdaptiveController:
         # Overhead / step-duration EWMAs (seconds).
         self._ovh_ewma: float | None = None
         self._dur_ewma: float | None = None
+        # Fleet-steered restore-batch ceiling (PR 19); None = the
+        # configured restore_batch_max stands alone.
+        self._restore_cap: int | None = None
         # Modeled weight fraction of decode-kind programs (EWMA) and
         # the decode-MBU EWMA when a peak is configured.
         self._wf_ewma: float | None = None
@@ -852,15 +855,33 @@ class AdaptiveController:
 
     # -- restore-batch sizing (host-tier promotion) ---------------------
 
+    def steer_restore_cap(self, cap: int | None) -> None:
+        """Fleet-steered override of the restore-batch ceiling (PR 19):
+        the fleet controller narrows or widens ``restore_batch_max``
+        from fleet-level restore-debt pressure without touching the
+        per-replica overhead steering below it. None clears the
+        override (back to the configured cap)."""
+        with self._lock:
+            self._restore_cap = (
+                None if cap is None else max(1, int(cap))
+            )
+
     def restore_batch(self) -> int:
         """Pages ``_restore_step`` may promote THIS iteration, within
         ``[1, restore_batch_max]`` — steered by the same un-overlapped
         overhead EWMA as chunk/depth (see ControlConfig). Unknown
         overhead (cold start) takes the full batch: before any decode
-        dispatch the loop has nothing to stall."""
+        dispatch the loop has nothing to stall. A fleet-steered cap
+        (``steer_restore_cap``) bounds the ceiling from above."""
         cfg = self.config
         cap = max(1, cfg.restore_batch_max)
+        with self._lock:
+            if self._restore_cap is not None:
+                cap = min(cap, self._restore_cap)
         if not cfg.tune_restore_batch or cap <= 1:
+            if cap != max(1, cfg.restore_batch_max):
+                with self._lock:
+                    self._decide("restore_batch", cap)
             return cap
         with self._lock:
             ovh = self._ovh_ewma
@@ -931,4 +952,7 @@ class AdaptiveController:
             )
             out["autotune_spec_engaged"] = int(self._spec_engaged)
             out["autotune_restore_debt_bytes"] = self._restore_debt
+            out["autotune_restore_cap"] = (
+                self._restore_cap if self._restore_cap is not None else -1
+            )
             return out

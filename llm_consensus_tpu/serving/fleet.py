@@ -95,6 +95,15 @@ from llm_consensus_tpu.server.metrics import (
 from llm_consensus_tpu.server.metrics import (
     REPLICA_SHARED_STORE_BYTES as _M_STORE_BYTES,
 )
+from llm_consensus_tpu.server.metrics import (
+    FLEET_REPLICAS as _M_FLEET_REPLICAS,
+)
+from llm_consensus_tpu.server.metrics import (
+    FLEET_SCALE as _M_FLEET_SCALE,
+)
+from llm_consensus_tpu.server.metrics import (
+    ROUTER_WEIGHT as _M_ROUTER_WEIGHT,
+)
 from llm_consensus_tpu.serving import flight as _flight
 from llm_consensus_tpu.serving.continuous import (
     ContinuousBatcher,
@@ -198,7 +207,8 @@ class PrefixRouter:
         batchers: list[ContinuousBatcher],
         config: FleetConfig,
         page_size: int,
-        roles: tuple[str, ...] | None = None,
+        roles: list | tuple | None = None,
+        states: list[str] | None = None,
     ):
         self.batchers = batchers
         self.config = config
@@ -207,6 +217,17 @@ class PrefixRouter:
         #: real requests through route() — they serve handoff warm-ups
         #: only (serving/disagg.py). None = every replica serves.
         self.roles = roles
+        #: Per-replica lifecycle states (PR 19) — ALIASED with the
+        #: owning ReplicaSet's list, mutated in place on elastic
+        #: transitions: the router skips "draining"/"retired" replicas
+        #: for NEW work while a draining replica's in-flight requests
+        #: finish on its still-running loop. None = every replica
+        #: permanently "serving" (the PR-14 static fleet).
+        self.states = states
+        #: Fleet-steered load weights (PR 19): multiplied into every
+        #: load_cost comparison, so weight > 1 repels new work and
+        #: weight < 1 attracts it. Missing entries weigh 1.0.
+        self._weights: list[float] = []
         self._rr = 0
         self._rr_lock = threading.Lock()
         # Pending-route hints: first prefix-page run -> (replica,
@@ -218,17 +239,48 @@ class PrefixRouter:
         # bucket key GroupTracker's stream planning uses.
         self._recent: dict[tuple, tuple[int, float]] = {}
 
+    def set_weights(self, weights: list[float]) -> None:
+        """Install fleet-controller load weights (PR 19). Replaces the
+        whole vector; replicas past its end weigh 1.0. Each weight is
+        also exported as ``gateway_router_weight{replica=}``."""
+        with self._rr_lock:
+            self._weights = [max(float(w), 1e-6) for w in weights]
+        for i, w in enumerate(self._weights):
+            _M_ROUTER_WEIGHT.labels(replica=str(i)).set(w)
+
+    def weights(self) -> list[float]:
+        """The effective weight per current replica (1.0 = neutral)."""
+        with self._rr_lock:
+            w = list(self._weights)
+        return [
+            w[i] if i < len(w) else 1.0
+            for i in range(len(self.batchers))
+        ]
+
+    def _weight(self, i: int) -> float:
+        with self._rr_lock:
+            return self._weights[i] if i < len(self._weights) else 1.0
+
+    def _in_service(self, i: int) -> bool:
+        return self.states is None or self.states[i] == "serving"
+
     def healthy(self) -> list[int]:
-        """Replicas whose serving loop is alive and fresh. Falls back
-        to ALL replicas when none qualify — routing somewhere beats
-        failing everywhere, and the gateway's /readyz is already
+        """In-service replicas whose serving loop is alive and fresh.
+        Draining/retired replicas (PR 19) are skipped deliberately —
+        the router must not hand NEW work to a replica that is
+        finishing its in-flight requests on the way out. Falls back to
+        ALL in-service replicas when none qualify — routing somewhere
+        beats failing everywhere, and the gateway's /readyz is already
         reporting the outage."""
         out = []
-        for i, b in enumerate(self.batchers):
-            hb = b.heartbeat()
+        candidates = [
+            i for i in range(len(self.batchers)) if self._in_service(i)
+        ]
+        for i in candidates:
+            hb = self.batchers[i].heartbeat()
             if hb["alive"] and hb["last_tick_age_s"] <= self.config.ready_stall_s:
                 out.append(i)
-        return out or list(range(len(self.batchers)))
+        return out or candidates or list(range(len(self.batchers)))
 
     def serving(self) -> list[int]:
         """Healthy replicas eligible for REAL requests: with roles
@@ -342,7 +394,9 @@ class PrefixRouter:
                     if hinted is not None and hinted in others:
                         return hinted, "rebalance"
                     dst = min(
-                        others, key=lambda i: self.batchers[i].load_cost()
+                        others,
+                        key=lambda i: self.batchers[i].load_cost()
+                        * self._weight(i),
                     )
                     ev = self.batchers[owner].request_export(ids)
                     if c.rebalance_export_wait_s > 0 and self._off_loop():
@@ -375,7 +429,13 @@ class PrefixRouter:
         # PR-10 cost model integrated over admitted requests), ties by
         # index for determinism. The hint makes this request's replica
         # the chain's home for burst-mates behind it.
-        dst = min(healthy, key=lambda i: (self.batchers[i].load_cost(), i))
+        dst = min(
+            healthy,
+            key=lambda i: (
+                self.batchers[i].load_cost() * self._weight(i),
+                i,
+            ),
+        )
         self._hint_put(chain, dst)
         return dst, "load"
 
@@ -452,7 +512,12 @@ class ReplicaSet:
             )
         replica_meshes = meshes if meshes is not None else [mesh] * k
         c = self.config
-        self.roles = resolve_roles(self.fleet_config.role, k)
+        # Roles/states are LISTS (PR 19): elastic spawn appends, and
+        # the router aliases both in place — replica indices stay
+        # stable for metric labels, routed counters, and hints across
+        # the whole lifecycle (a retired slot is never reused).
+        self.roles = list(resolve_roles(self.fleet_config.role, k))
+        self.states: list[str] = ["serving"] * k
         tier_on = (
             c.host_cache_bytes > 0 and c.share_prefix and c.prefill_chunk > 0
         )
@@ -513,6 +578,15 @@ class ReplicaSet:
             if self.store is not None and scope is None:
                 scope = b._store_scope
             self.batchers.append(b)
+        # Elastic spawn materials (PR 19): references only — jax
+        # arrays are immutable and a spawned replica re-shards the
+        # SAME parameter tree exactly like the construction loop above.
+        self._params = params
+        self._draft = draft
+        self._draft_map = draft_map
+        self._control_cfg = control
+        self._spawn_mesh = replica_meshes[-1]
+        self._store_scope = scope
         # Shared-config audit (PR 18): role_config must hand every
         # decode/mixed replica the SAME live instance (prefill copies
         # are the one sanctioned divergence — their decode machinery is
@@ -529,7 +603,11 @@ class ReplicaSet:
                     "alias the fleet's one shared instance"
                 )
         self.router = PrefixRouter(
-            self.batchers, self.fleet_config, c.page_size, roles=self.roles
+            self.batchers,
+            self.fleet_config,
+            c.page_size,
+            roles=self.roles,
+            states=self.states,
         )
         # Prefill→decode handoffs engage only when a prefill-role
         # replica exists AND the page transport is live (a roled fleet
@@ -552,6 +630,11 @@ class ReplicaSet:
             {r: 0 for r in ROUTE_REASONS} for _ in range(k)
         ]
         self._preempt_requests = [0] * k
+        # Elastic lifecycle mirrors of gateway_fleet_scale_total
+        # (lockstep tested) + a guard serializing spawn/retire.
+        self._scale = {"spawn": 0, "drain": 0, "retire": 0}
+        self._scale_lock = threading.Lock()
+        self._refresh_state_gauge()
 
     # -- serving --------------------------------------------------------
 
@@ -722,6 +805,151 @@ class ReplicaSet:
             )
         return owner
 
+    # -- elastic replicas (PR 19) ---------------------------------------
+
+    def _refresh_state_gauge(self) -> None:
+        for state in ("serving", "draining", "retired"):
+            _M_FLEET_REPLICAS.labels(state=state).set(
+                sum(1 for s in self.states if s == state)
+            )
+
+    def _note_scale(self, action: str, idx: int, **meta) -> None:
+        """One transition = counter + mirror + flight event + gauge
+        refresh (the PR-15 _decide discipline at fleet altitude)."""
+        _M_FLEET_SCALE.labels(action=action).inc()
+        with self._lock:
+            self._scale[action] += 1
+        self._refresh_state_gauge()
+        _flight.flight_recorder().record(
+            "scale", time.perf_counter(), action=action, replica=idx, **meta
+        )
+
+    def serving_indices(self) -> list[int]:
+        return [i for i, s in enumerate(self.states) if s == "serving"]
+
+    def spawn_replica(self) -> int:
+        """Add one mixed-role batcher replica and put it in service.
+
+        The new replica is built exactly like the construction loop —
+        same shared ContinuousConfig instance (the live-knob-flip
+        contract extends to it), same shared parameter tree, same
+        shared store (reusing the cached store-key scope, no
+        param-tree re-walk) — and appended so every existing replica's
+        index, metric labels, and routing hints stay valid. The router
+        sees it on its next ``healthy()`` probe; cold pools make it
+        the least-loaded target, so new work drains toward it
+        immediately. Returns the new replica's index."""
+        from llm_consensus_tpu.serving.control import AdaptiveController
+        from llm_consensus_tpu.serving.disagg import role_config
+
+        with self._scale_lock:
+            ctrl = (
+                AdaptiveController(self._control_cfg)
+                if self._control_cfg is not None
+                else None
+            )
+            b = ContinuousBatcher(
+                self.cfg,
+                self._params,
+                tokenizer=self.tokenizer,
+                config=role_config(self.config, "mixed"),
+                mesh=self._spawn_mesh,
+                draft=self._draft,
+                draft_map=self._draft_map,
+                host_store=self.store,
+                host_store_scope=self._store_scope,
+                controller=ctrl,
+            )
+            idx = len(self.batchers)
+            with self._lock:
+                self._routed.append({r: 0 for r in ROUTE_REASONS})
+                self._preempt_requests.append(0)
+            # Append order: batcher first, then role/state — a router
+            # probe between the two sees a shorter states list and
+            # simply skips the newcomer for one decision.
+            self.batchers.append(b)
+            self.roles.append("mixed")
+            self.states.append("serving")
+            self._note_scale("spawn", idx)
+            return idx
+
+    def retire_replica(
+        self, idx: int, wait_s: float = 60.0, poll_s: float = 0.05
+    ) -> dict:
+        """Drain and retire replica ``idx`` with ZERO lost requests.
+
+        The sequence is the PR-14 rebalance discipline pointed at a
+        whole replica: (1) mark ``draining`` — the router immediately
+        stops handing it NEW work while its loop keeps running; (2)
+        wait for its admitted requests (waiting + slotted) to finish —
+        their futures resolve normally; (3) demote its resident
+        registry chains to the shared HostPageStore (the preempt/evict
+        path — after the drain nothing is pinned, so the chains
+        re-home: any surviving replica's next same-prefix admission
+        restores them at device_put latency instead of re-prefilling);
+        (4) stop the loop and mark ``retired``. The slot stays in
+        ``batchers`` so indices never shift.
+
+        Raises TimeoutError if in-flight work outlives ``wait_s`` —
+        the replica is left DRAINING (never killed with live work;
+        call again to finish the retire)."""
+        if not 0 <= idx < len(self.batchers):
+            raise ValueError(f"no replica {idx}")
+        if self.states[idx] not in ("serving", "draining"):
+            raise ValueError(
+                f"replica {idx} is {self.states[idx]}, not retirable"
+            )
+        if self.roles[idx] == "prefill":
+            raise ValueError(
+                "prefill-role replicas anchor the handoff tier; "
+                "elastic retire covers decode-capable replicas only"
+            )
+        with self._scale_lock:
+            survivors = [
+                i for i in self.serving_indices() if i != idx
+            ]
+            if not survivors:
+                raise ValueError(
+                    "cannot retire the last serving replica"
+                )
+            b = self.batchers[idx]
+            if self.states[idx] == "serving":
+                self.states[idx] = "draining"
+                self._note_scale(
+                    "drain", idx, active=b.active_requests()
+                )
+            deadline = time.monotonic() + wait_s
+            while b.active_requests() > 0:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica {idx} still has "
+                        f"{b.active_requests()} in-flight requests "
+                        f"after {wait_s}s; left draining"
+                    )
+                time.sleep(poll_s)
+            # Chains re-home through the shared store: demote every
+            # reclaimable registry page (nothing is pinned post-drain)
+            # so survivors restore instead of re-prefilling.
+            demoted = 0
+            if self.store is not None:
+                pages = b.cached_chain_pages()
+                if pages:
+                    b.request_preempt(pages)
+                    while (
+                        b.cached_chain_pages() > 0
+                        and time.monotonic() <= deadline
+                    ):
+                        time.sleep(poll_s)
+                    demoted = pages - b.cached_chain_pages()
+            b.close()
+            self.states[idx] = "retired"
+            self._note_scale("retire", idx, demoted_pages=demoted)
+            return {
+                "replica": idx,
+                "demoted_pages": demoted,
+                "serving": len(self.serving_indices()),
+            }
+
     # -- observability / lifecycle --------------------------------------
 
     def prefix_probe(self, ids) -> dict:
@@ -746,19 +974,28 @@ class ReplicaSet:
 
     def heartbeat(self) -> dict:
         """Aggregate serving-loop liveness: ``alive`` only when EVERY
-        replica's loop is alive (a degraded fleet must flip /readyz —
-        one wedged replica is a capacity loss the balancer upstream
-        should see), ``last_tick_age_s`` is the STALEST replica's, and
-        ``replicas`` carries each loop's own heartbeat so the gateway
-        can name the wedged index."""
+        in-service replica's loop is alive (a degraded fleet must flip
+        /readyz — one wedged replica is a capacity loss the balancer
+        upstream should see), ``last_tick_age_s`` is the stalest such
+        replica's, and ``replicas`` carries each loop's own heartbeat
+        so the gateway can name the wedged index. Draining/retired
+        replicas (PR 19) report their lifecycle state in their entry
+        but are EXCLUDED from the aggregate — a deliberate drain or a
+        stopped retired loop is not an outage."""
         hbs = [b.heartbeat() for b in self.batchers]
+        for h, s in zip(hbs, self.states):
+            if s != "serving":
+                h["state"] = s
+        act = [
+            h for h, s in zip(hbs, self.states) if s == "serving"
+        ] or hbs
         return {
-            "alive": all(h["alive"] for h in hbs),
-            "last_tick_age_s": max(h["last_tick_age_s"] for h in hbs),
+            "alive": all(h["alive"] for h in act),
+            "last_tick_age_s": max(h["last_tick_age_s"] for h in act),
             "last_step_age_s": max(
                 (
                     h["last_step_age_s"]
-                    for h in hbs
+                    for h in act
                     if h["last_step_age_s"] is not None
                 ),
                 default=None,
@@ -781,6 +1018,7 @@ class ReplicaSet:
             # wins) autotune families: each replica's stats carry its
             # role, the PR-14/15 per-replica convention.
             per[i]["role"] = role
+            per[i]["state"] = self.states[i]
         for i, b in enumerate(self.batchers):
             # The same accessors the route-time refresh uses — ONE
             # definition of each gauge's value (a second copy keyed on
@@ -795,9 +1033,14 @@ class ReplicaSet:
         with self._lock:
             routed = [dict(r) for r in self._routed]
             preempts = list(self._preempt_requests)
+            scale = dict(self._scale)
         agg_lookups = sum(s["prefix_lookups"] for s in per)
         return {
             "replicas": len(self.batchers),
+            "serving_replicas": len(self.serving_indices()),
+            "states": list(self.states),
+            "router_weights": self.router.weights(),
+            "scale_events": scale,
             "policy": self.fleet_config.policy,
             "roles": list(self.roles),
             "role_handoffs": (
@@ -851,8 +1094,9 @@ class ReplicaSet:
         }
 
     def close(self) -> None:
-        for b in self.batchers:
-            b.close()
+        for b, s in zip(self.batchers, self.states):
+            if s != "retired":  # retired loops already stopped
+                b.close()
 
 
 class FleetBackend(_backend_base.Backend):

@@ -149,8 +149,13 @@ class BatchScheduler:
 
     def heartbeat(self) -> dict:
         """Scheduler-loop liveness (see ContinuousBatcher.heartbeat)."""
+        alive = self._thread.is_alive() and not self._stop.is_set()
         return {
-            "alive": self._thread.is_alive() and not self._stop.is_set(),
+            "alive": alive,
+            # Uniform lifecycle shape with the continuous batcher (PR
+            # 19): every heartbeat-bearing backend reports a state so
+            # readiness probes can branch on one key.
+            "state": "serving" if alive else "stopped",
             "last_tick_age_s": time.monotonic() - self._hb_tick,
             "last_step_age_s": None,
         }

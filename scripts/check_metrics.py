@@ -38,6 +38,7 @@ SCANNED = (
     "llm_consensus_tpu/serving/offload.py",
     "llm_consensus_tpu/serving/flight.py",
     "llm_consensus_tpu/serving/fleet.py",
+    "llm_consensus_tpu/serving/fleet_control.py",
     "llm_consensus_tpu/serving/control.py",
     "llm_consensus_tpu/serving/disagg.py",
     "llm_consensus_tpu/serving/remote_store.py",

@@ -68,26 +68,45 @@ def test_await_chip_attempts_record_success(monkeypatch):
 
 
 def test_await_chip_backoff_escalates_on_identical_failures(monkeypatch):
-    """Two identical consecutive (phase, rc) failures climb one rung
-    of _CHIP_BACKOFF_S: the sleep sequence runs 45, 90, 90, 180, ...
-    and every attempt lands a structured record in ``attempts``."""
+    """EVERY further identical consecutive (phase, rc) failure climbs
+    one rung of _CHIP_BACKOFF_S (45, 90, 180, 180, ... — PR 19's
+    faster ladder), every attempt lands an enriched structured record,
+    and after _CHIP_SAME_SIG_MAX identical failures the loop gives up
+    EARLY with a terminal ``gave_up`` entry instead of burning the
+    rest of the wait budget on a provably hard-down tunnel."""
     monkeypatch.setattr(bench, "_PROBE_SRC", "import sys; sys.exit(7)")
     sleeps = []
     monkeypatch.setattr(bench.time, "sleep", sleeps.append)
     attempts = []
+    # Budget far beyond the probes' wall time: the identical-failure
+    # cap, not the deadline, must terminate the loop.
     assert (
-        bench._await_chip(2.0, probe_timeout_s=30, attempts=attempts)
+        bench._await_chip(3600.0, probe_timeout_s=30, attempts=attempts)
         is False
     )
-    assert attempts and all(
-        a == {"phase": "probe", "rc": 7, "elapsed": a["elapsed"]}
-        for a in attempts
+    probes = [a for a in attempts if a["phase"] == "probe"]
+    assert len(probes) == bench._CHIP_SAME_SIG_MAX
+    for i, a in enumerate(probes):
+        assert a["attempt"] == i + 1
+        assert a["rc"] == 7
+        assert a["elapsed"] >= 0 and a["t_offset"] >= 0
+        assert "stderr" in a  # tail captured (empty for a bare exit)
+    # Retried attempts record the backoff they slept.
+    assert [a["sleep_s"] for a in probes if "sleep_s" in a] == [
+        45.0,
+        90.0,
+        180.0,
+        180.0,
+    ]
+    assert attempts[-1]["phase"] == "gave_up"
+    assert attempts[-1]["rc"] == 7
+    assert (
+        attempts[-1]["identical_failures"] == bench._CHIP_SAME_SIG_MAX
     )
     # Patching global time.sleep also records subprocess reaping polls;
     # only the backoff rungs count.
     rungs = [s for s in sleeps if s in bench._CHIP_BACKOFF_S]
-    expected = [45.0, 90.0, 90.0, 180.0]
-    assert rungs[: len(expected)] == expected[: len(rungs)]
+    assert rungs == [45.0, 90.0, 180.0, 180.0]
 
 
 def test_await_chip_timeout_phase_recorded(monkeypatch):

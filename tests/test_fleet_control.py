@@ -1,0 +1,649 @@
+"""Fleet control plane (PR 19).
+
+Covers the four coupled mechanisms end to end: SLO-aware admission
+(deadline-aware would-miss shedding — the victim is the request that
+WILL miss its target, never simply the newest; miss/headroom families
+lockstep with stats()), tenant fair-share (a 10x storm cannot starve
+the quiet tenant: WFQ interleaving bounds its wait, the door cap sheds
+the over-share tenant with ``tenant_over``, and
+``gateway_tenant_cost_bytes{tenant=}`` moves in lockstep between
+Prometheus and stats()), the :class:`FleetController` decision loop
+(router weight steering, group/restore sizing, elastic spawn/retire —
+every setpoint CHANGE lands the ``gateway_fleet_decisions_total``
+counter, the stats() mirror, and a ``fleet`` flight event), and the
+elastic replica lifecycle on a REAL fleet (spawn serves traffic,
+retire drains with zero lost requests and byte-identical text, the
+draining state is distinct from wedged in ``/readyz`` and the router
+skips it for new work).
+"""
+
+import asyncio
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_consensus_tpu.models.configs import get_config
+from llm_consensus_tpu.models.transformer import init_params
+from llm_consensus_tpu.server.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    QueueFullError,
+)
+from llm_consensus_tpu.server.metrics import REGISTRY, MetricsRegistry
+from llm_consensus_tpu.serving import flight as _flight
+from llm_consensus_tpu.serving.continuous import ContinuousConfig
+from llm_consensus_tpu.serving.fleet import (
+    FleetBackend,
+    FleetConfig,
+    ReplicaSet,
+)
+from llm_consensus_tpu.serving.fleet_control import (
+    FleetControlConfig,
+    FleetController,
+)
+
+CFG = get_config("test-tiny")
+
+_HEADER = "Panel shared header for every persona, forty ch: "
+
+_FCFG = dict(
+    max_slots=2,
+    page_size=16,
+    n_pages=32,
+    pages_per_seq=8,
+    max_new_tokens=4,
+    seq_buckets=(16, 32, 64),
+    prefill_chunk=16,
+    share_prefix=True,
+    host_cache_bytes=64 << 20,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _fleet(params, replicas=2, fleet_kw=None, **cfg_over):
+    return ReplicaSet(
+        CFG,
+        params,
+        config=ContinuousConfig(**{**_FCFG, **cfg_over}),
+        fleet=FleetConfig(replicas=replicas, **(fleet_kw or {})),
+    )
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware admission: would-miss victim selection + miss accounting
+# ---------------------------------------------------------------------------
+
+
+def test_would_miss_shed_victims_doomed_not_newest():
+    """At a full queue the shed victim is the queued request that WILL
+    miss its SLO (negative predicted slack), never simply the newest
+    arrival — the doomed request 429s with ``slo_miss`` and the
+    newcomer takes its place."""
+
+    async def main():
+        reg = MetricsRegistry()
+        c = AdmissionController(
+            AdmissionConfig(
+                max_queue=2,
+                max_inflight=1,
+                slo_classes={"fast": 0.05, "slow": 60.0},
+            ),
+            registry=reg,
+        )
+        gate = asyncio.Event()
+
+        async def wait():
+            await gate.wait()
+
+        blocker = asyncio.create_task(c.submit(wait, slo="slow"))
+        await asyncio.sleep(0.02)  # blocker holds the one in-flight slot
+        doomed = asyncio.create_task(c.submit(wait, slo="fast"))
+        healthy = asyncio.create_task(c.submit(wait, slo="slow"))
+        # Let the fast-class request age past its 50ms target while
+        # queued: its predicted slack goes negative.
+        await asyncio.sleep(0.12)
+        # Queue full (bound 2). The newcomer has 60s of slack; the
+        # doomed fast request is shed in its favor.
+        newcomer = asyncio.create_task(c.submit(wait, slo="slow"))
+        await asyncio.sleep(0.02)
+        assert doomed.done()
+        err = doomed.exception()
+        assert isinstance(err, QueueFullError) and err.slo_miss is True
+        # The newest arrival was ADMITTED, the healthy one untouched.
+        assert not newcomer.done() and not healthy.done()
+        s = c.stats()
+        assert s["slo_sheds"] == 1
+        assert s["slo_miss"] == {"fast": 1}
+        # Prometheus lockstep (per-instance registry).
+        fam = reg.get("gateway_slo_shed_total")
+        assert fam.labels(**{"class": "fast"}).value == 1.0
+        fam = reg.get("gateway_slo_miss_total")
+        assert fam.labels(**{"class": "fast"}).value == 1.0
+        gate.set()
+        await asyncio.gather(
+            blocker, healthy, newcomer, return_exceptions=True
+        )
+
+    asyncio.run(main())
+
+
+def test_slo_miss_at_dispatch_and_headroom_lockstep():
+    """A dispatch whose queue wait exceeded its class target is a
+    recorded miss; every SLO-tagged admission observes predicted
+    headroom — both families lockstep with stats(). Unknown classes
+    are rejected at the door (the gateway's 400)."""
+
+    async def main():
+        reg = MetricsRegistry()
+        c = AdmissionController(
+            AdmissionConfig(
+                max_inflight=1,
+                slo_classes={"tight": 0.01},
+                default_slo_class="tight",
+            ),
+            registry=reg,
+        )
+        with pytest.raises(ValueError, match="unknown slo class"):
+            await c.submit(lambda: asyncio.sleep(0), slo="nope")
+        gate = asyncio.Event()
+
+        async def wait():
+            await gate.wait()
+
+        blocker = asyncio.create_task(c.submit(wait))
+        await asyncio.sleep(0.02)
+        # Defaulted into the tight class; waits > 10ms while queued.
+        late = asyncio.create_task(c.submit(wait))
+        await asyncio.sleep(0.05)
+        gate.set()
+        await asyncio.gather(blocker, late)
+        s = c.stats()
+        assert s["slo_miss"].get("tight", 0) >= 1
+        assert s["slo_headroom_count"] == 2
+        fam = reg.get("gateway_slo_miss_total")
+        assert fam.labels(**{"class": "tight"}).value == float(
+            s["slo_miss"]["tight"]
+        )
+        h = reg.get("gateway_slo_headroom_seconds")
+        assert h.count == 2
+        assert h.sum == pytest.approx(s["slo_headroom_sum"])
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Tenant fair-share: the 10x storm (satellite 3) + door cap
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_storm_quiet_tenant_completes_bounded():
+    """One tenant floods at 10x the quiet tenant's rate. WFQ dispatch
+    interleaves: every quiet request completes with a bounded number of
+    storm dispatches ahead of it (not behind the whole flood), and
+    ``gateway_tenant_cost_bytes{tenant=}`` moves in lockstep between
+    Prometheus and stats()."""
+
+    async def main():
+        reg = MetricsRegistry()
+        c = AdmissionController(
+            AdmissionConfig(
+                max_queue=64,
+                max_inflight=1,
+                tenant_fair_share=True,
+            ),
+            registry=reg,
+        )
+        order: list[str] = []
+
+        def thunk(tag):
+            async def run():
+                order.append(tag)
+
+            return run
+
+        hold = asyncio.Event()
+
+        async def blocker_run():
+            await hold.wait()
+
+        # Hold the dispatcher so the whole storm queues before any
+        # dispatch happens — worst case for the quiet tenant.
+        blocker = asyncio.create_task(
+            c.submit(blocker_run, tenant="storm")
+        )
+        await asyncio.sleep(0.02)
+        storm = [
+            asyncio.create_task(
+                c.submit(thunk(f"s{i}"), tenant="storm")
+            )
+            for i in range(40)
+        ]
+        await asyncio.sleep(0)
+        quiet = [
+            asyncio.create_task(
+                c.submit(thunk(f"q{i}"), tenant="quiet")
+            )
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.02)
+        hold.set()
+        await asyncio.gather(blocker, *storm, *quiet)
+        # Every quiet request completed ...
+        qpos = [order.index(f"q{i}") for i in range(4)]
+        # ... and each dispatched interleaved near the front: the k-th
+        # quiet request admits at WFQ tag k+1, tying the k-th storm
+        # request instead of queueing behind all 40. Bound with slack.
+        assert max(qpos) < 12, (qpos, order[:16])
+        s = c.stats()
+        assert s["tenant_cost_bytes"] == {"storm": 41.0, "quiet": 4.0}
+        fam = reg.get("gateway_tenant_cost_bytes")
+        for tenant, total in s["tenant_cost_bytes"].items():
+            assert fam.labels(tenant=tenant).value == total
+
+    asyncio.run(main())
+
+
+def test_tenant_over_share_shed_at_door():
+    """Under contention (another tenant queued) a tenant past its
+    weighted admitted-cost share is shed at the door with
+    ``tenant_over`` — and the overflow hook is never consulted for it
+    (preempting backend capacity cannot fix unfairness). Without
+    contention the cap is inert (work-conserving)."""
+
+    async def main():
+        reg = MetricsRegistry()
+        c = AdmissionController(
+            AdmissionConfig(
+                max_queue=64,
+                max_inflight=1,
+                tenant_fair_share=True,
+                fair_share_slack=1.1,
+            ),
+            registry=reg,
+        )
+        hook_calls = []
+        c.overflow_hook = lambda: hook_calls.append(1) or True
+        hold = asyncio.Event()
+
+        async def wait():
+            await hold.wait()
+
+        # No contention: the greedy tenant admits freely.
+        tasks = [
+            asyncio.create_task(c.submit(wait, tenant="greedy"))
+            for _ in range(10)
+        ]
+        await asyncio.sleep(0.02)
+        # Contention arrives: one quiet request queues.
+        tasks.append(
+            asyncio.create_task(c.submit(wait, tenant="other"))
+        )
+        await asyncio.sleep(0.02)
+        # Greedy is far past a 50% fair share of the recent window.
+        with pytest.raises(QueueFullError) as ei:
+            await c.submit(wait, tenant="greedy")
+        assert ei.value.tenant_over is True
+        assert not hook_calls, "overflow hook consulted for fairness shed"
+        s = c.stats()
+        assert s["tenant_sheds"] == {"greedy": 1}
+        fam = reg.get("gateway_tenant_shed_total")
+        assert fam.labels(tenant="greedy").value == 1.0
+        hold.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# FleetController decision loop (fake fleet: fast, deterministic)
+# ---------------------------------------------------------------------------
+
+
+class _FakeController:
+    def __init__(self):
+        self.restore_debt_bytes = 0.0
+        self.caps = []
+
+    def steer_restore_cap(self, cap):
+        self.caps.append(cap)
+
+
+class _FakeBatcher:
+    def __init__(self):
+        self.load = 0.0
+        self.depth = 0
+        self.active = 0
+        self.group_caps = []
+        self.controller = _FakeController()
+
+    def waiting_depth(self):
+        return self.depth
+
+    def active_requests(self):
+        return self.active
+
+    def load_cost(self):
+        return self.load
+
+    def request_group_cap(self, n):
+        self.group_caps.append(n)
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.weight_sets = []
+
+    def set_weights(self, w):
+        self.weight_sets.append(list(w))
+
+
+class _FakeFleetConfig:
+    max_slots = 4
+    host_cache_bytes = 1000
+
+
+class _FakeFleet:
+    def __init__(self, n=2):
+        self.batchers = [_FakeBatcher() for _ in range(n)]
+        self.roles = ["mixed"] * n
+        self.states = ["serving"] * n
+        self.router = _FakeRouter()
+        self.config = _FakeFleetConfig()
+        self.store = object()
+        self.retired = []
+
+    def serving_indices(self):
+        return [i for i, s in enumerate(self.states) if s == "serving"]
+
+    def spawn_replica(self):
+        self.batchers.append(_FakeBatcher())
+        self.roles.append("mixed")
+        self.states.append("serving")
+        return len(self.batchers) - 1
+
+    def retire_replica(self, idx, wait_s=60.0):
+        self.states[idx] = "retired"
+        self.retired.append(idx)
+        return {"replica": idx}
+
+
+def _decision_values():
+    fam = REGISTRY.get("gateway_fleet_decisions_total")
+    return {
+        d: fam.labels(decision=d).value
+        for d in (
+            "router_weights",
+            "group_cap",
+            "restore_cap",
+            "spawn",
+            "retire",
+        )
+    }
+
+
+def test_fleet_controller_decisions_counters_and_flight_lockstep():
+    """One synchronous pass over every decision kind: router weights
+    from relative load, group cap + restore cap from queue pressure,
+    elastic spawn from sustained depth, elastic retire from sustained
+    idleness. Each setpoint CHANGE moves gateway_fleet_decisions_total,
+    the stats() mirror, and a ``fleet`` flight event — steady-state
+    ticks move none of them."""
+    rs = _FakeFleet(2)
+    ctrl = FleetController(
+        rs,
+        FleetControlConfig(
+            slo_classes={"interactive": 2.0},
+            elastic_min=2,
+            elastic_max=3,
+            spawn_depth=2.0,
+            spawn_sustain_ticks=2,
+            retire_idle_ticks=2,
+        ),
+    )
+    base = _decision_values()
+    fleet_events0 = sum(
+        1 for e in _flight.flight_recorder().events() if e.kind == "fleet"
+    )
+
+    # Tick 1: unequal load, saturating depth, zero restore debt.
+    rs.batchers[0].load, rs.batchers[1].load = 1000.0, 3000.0
+    rs.batchers[0].depth, rs.batchers[1].depth = 6, 4
+    ctrl.tick()
+    assert rs.router.weight_sets[-1] == [0.5, 1.5]
+    # Pressure 10/8 >= 1.0: group cap widens to max_slots on every
+    # serving batcher; restore batches narrow under queue pressure.
+    assert rs.batchers[0].group_caps[-1] == 4
+    assert rs.batchers[1].group_caps[-1] == 4
+    assert rs.batchers[0].controller.caps[-1] == 2
+    d1 = _decision_values()
+    assert d1["router_weights"] - base["router_weights"] == 1
+    assert d1["group_cap"] - base["group_cap"] == 1
+    assert d1["restore_cap"] - base["restore_cap"] == 1
+
+    # Tick 2: same signals — gauges refresh, decisions do NOT move
+    # (spawn streak hits its sustain threshold and fires instead).
+    ctrl.tick()
+    d2 = _decision_values()
+    assert d2["router_weights"] == d1["router_weights"]
+    assert d2["group_cap"] == d1["group_cap"]
+    assert len(rs.router.weight_sets) == 2  # refreshed every tick
+    assert d2["spawn"] - base["spawn"] == 1
+    assert len(rs.batchers) == 3 and rs.states[2] == "serving"
+
+    # Heavy restore debt clears the narrowed cap.
+    for b in rs.batchers:
+        b.controller.restore_debt_bytes = 200.0  # 600/1000 >= 0.25
+    ctrl.tick()
+    d3 = _decision_values()
+    assert d3["restore_cap"] - d2["restore_cap"] == 1
+    assert rs.batchers[0].controller.caps[-1] is None
+
+    # Fleet goes idle: after the sustain window the controller retires
+    # the highest-index serving replica back down to elastic_min.
+    for b in rs.batchers:
+        b.load, b.depth, b.active = 0.0, 0, 0
+        b.controller.restore_debt_bytes = 0.0
+    ctrl.tick()
+    ctrl.tick()
+    d4 = _decision_values()
+    assert d4["retire"] - base["retire"] == 1
+    assert rs.retired == [2] and rs.states[2] == "retired"
+    # At elastic_min: further idle ticks retire nothing.
+    ctrl.tick()
+    ctrl.tick()
+    assert _decision_values()["retire"] == d4["retire"]
+
+    # stats() mirror lockstep with the Prometheus deltas.
+    s = ctrl.stats()
+    final = _decision_values()
+    for d in ("router_weights", "group_cap", "restore_cap", "spawn", "retire"):
+        assert s[f"fleet_decisions_{d}"] == final[d] - base[d], d
+    # Every decision landed one ``fleet`` flight event.
+    fleet_events = [
+        e for e in _flight.flight_recorder().events() if e.kind == "fleet"
+    ]
+    assert len(fleet_events) - fleet_events0 == sum(
+        final[d] - base[d] for d in final
+    )
+    kinds = {e.meta["decision"] for e in fleet_events}
+    assert kinds >= {
+        "router_weights",
+        "group_cap",
+        "restore_cap",
+        "spawn",
+        "retire",
+    }
+
+
+def test_fleet_controller_admission_kwargs_bridge():
+    """FleetControlConfig.admission_kwargs() splats cleanly into
+    AdmissionConfig — the serve wiring cannot drift fields."""
+    cfg = FleetControlConfig(
+        slo_classes={"gold": 1.0},
+        default_slo_class="gold",
+        tenant_weights={"a": 2.0},
+    )
+    ac = AdmissionConfig(**cfg.admission_kwargs())
+    assert ac.slo_classes == {"gold": 1.0}
+    assert ac.default_slo_class == "gold"
+    assert ac.tenant_fair_share is True
+    assert ac.tenant_weight("a") == 2.0
+    assert ac.tenant_weight("b") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Elastic lifecycle on a REAL fleet + draining /readyz (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_spawn_retire_zero_lost_byte_identical(params):
+    """One full elastic cycle against live traffic: a spawned replica
+    joins routing (same shared config, so the construction-time audit
+    holds), retire drains it through the shared host tier with ZERO
+    lost requests, and every response is byte-identical to the fixed
+    fleet's greedy baseline. Scale counters move in lockstep across
+    Prometheus and stats()."""
+    fam = REGISTRY.get("gateway_fleet_scale_total")
+
+    def scale_values():
+        return {
+            a: fam.labels(action=a).value
+            for a in ("spawn", "drain", "retire")
+        }
+
+    fleet = _fleet(params)
+    try:
+        prompts = [_HEADER + f"elastic {i}" for i in range(4)]
+        base_scale = scale_values()
+        baseline = [
+            fleet.submit(p, max_new_tokens=4, temperature=0.0, seed=7)
+            .result(timeout=300)
+            .text
+            for p in prompts
+        ]
+        idx = fleet.spawn_replica()
+        assert idx == 2
+        assert fleet.states[idx] == "serving"
+        assert idx in fleet.router.healthy()
+        assert fleet.stats()["serving_replicas"] == 3
+        # Traffic in flight across 3 replicas while the spawned one
+        # retires: the drain finishes whatever landed on it first.
+        futs = [
+            fleet.submit(p, max_new_tokens=4, temperature=0.0, seed=7)
+            for p in prompts
+        ]
+        out = fleet.retire_replica(idx, wait_s=120.0)
+        assert out["replica"] == idx and out["serving"] == 2
+        texts = [f.result(timeout=300).text for f in futs]
+        assert texts == baseline  # zero lost, byte-identical
+        assert fleet.states[idx] == "retired"
+        assert idx not in fleet.router.healthy()
+        hb = fleet.heartbeat()
+        assert hb["alive"] is True  # retired loop must not read dead
+        assert hb["replicas"][idx]["state"] == "retired"
+        s = fleet.stats()
+        assert s["serving_replicas"] == 2
+        assert s["scale_events"] == {"spawn": 1, "drain": 1, "retire": 1}
+        now_scale = scale_values()
+        for action, n in s["scale_events"].items():
+            assert now_scale[action] - base_scale[action] == n, action
+        # The retired slot is never reused and never routed.
+        ids = fleet.batchers[0].tokenizer.encode(_HEADER + "post-retire")
+        for _ in range(4):
+            assert fleet.router.route(ids)[0] != idx
+        # Flight ring witnessed the lifecycle.
+        scale_evs = [
+            e
+            for e in _flight.flight_recorder().events()
+            if e.kind == "scale" and e.meta.get("replica") == idx
+        ]
+        assert [e.meta["action"] for e in scale_evs] == [
+            "spawn",
+            "drain",
+            "retire",
+        ]
+    finally:
+        fleet.close()
+
+
+def test_draining_replica_distinct_in_readyz_and_router_skips(params):
+    """Satellite 2: a DRAINING replica reports its own state — /readyz
+    stays ready and names it under ``draining_replicas`` (not
+    ``wedged_replicas``), the router skips it for NEW work, and its
+    in-flight work still completes."""
+    from llm_consensus_tpu.server.gateway import Gateway, GatewayConfig
+
+    fleet = _fleet(params)
+    try:
+        gw = Gateway(
+            FleetBackend(fleet),
+            config=GatewayConfig(port=0, ready_stall_s=30.0),
+            registry=MetricsRegistry(),
+        )
+        ready, doc = gw._readiness()
+        assert ready is True
+        assert doc["backend"]["replicas"][1]["state"] == "serving"
+        fleet.states[1] = "draining"
+        try:
+            ready, doc = gw._readiness()
+            assert ready is True, doc  # draining is NOT wedged
+            assert doc["draining_replicas"] == [1]
+            assert "wedged_replicas" not in doc
+            assert doc["backend"]["replicas"][1]["state"] == "draining"
+            # New work skips the draining replica...
+            assert fleet.router.healthy() == [0]
+            ids = fleet.batchers[0].tokenizer.encode(
+                _HEADER + "fresh work"
+            )
+            for _ in range(3):
+                assert fleet.router.route(ids)[0] == 0
+            # ...while work already on it still completes.
+            fut = fleet.batchers[1].submit(
+                _HEADER + "in-flight finishes", max_new_tokens=4
+            )
+            assert isinstance(fut.result(timeout=300).text, str)
+        finally:
+            fleet.states[1] = "serving"
+        ready, doc = gw._readiness()
+        assert ready is True and "draining_replicas" not in doc
+        assert 1 in fleet.router.healthy()
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# Router weight steering shifts load (stub batchers: pure routing)
+# ---------------------------------------------------------------------------
+
+
+def test_router_weights_steer_load_balance():
+    """set_weights biases the router's least-load pick: with equal raw
+    load, new work flows to the replica whose weight is lower (the
+    controller inflates a hot replica's cost so it repels work)."""
+    from llm_consensus_tpu.serving.fleet import PrefixRouter
+
+    class _Stub:
+        def prefix_probe(self, ids):
+            return {"registry_tokens": 0, "host_tokens": 0}
+
+        def load_cost(self):
+            return 100.0
+
+        def heartbeat(self):
+            return {"alive": True, "last_tick_age_s": 0.0}
+
+    stubs = [_Stub(), _Stub()]
+    router = PrefixRouter(stubs, FleetConfig(replicas=2), page_size=16)
+    router.set_weights([4.0, 1.0])
+    assert router.weights() == [4.0, 1.0]
+    idx, _ = router.route([1, 2, 3])
+    assert idx == 1  # 100*1.0 < 100*4.0
+    router.set_weights([1.0, 4.0])
+    idx, _ = router.route([1, 2, 3])
+    assert idx == 0

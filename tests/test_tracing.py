@@ -513,6 +513,7 @@ def test_check_metrics_detects_undeclared_family(tmp_path):
         "llm_consensus_tpu/serving/offload.py",
         "llm_consensus_tpu/serving/flight.py",
         "llm_consensus_tpu/serving/fleet.py",
+        "llm_consensus_tpu/serving/fleet_control.py",
         "llm_consensus_tpu/serving/control.py",
         "llm_consensus_tpu/serving/disagg.py",
         "llm_consensus_tpu/serving/remote_store.py",
