@@ -519,6 +519,23 @@ def main() -> int:
         "errors counted)",
     )
     p.add_argument(
+        "--serve-fleet-obs",
+        action="store_true",
+        help="fleet observability federation A/B leg (PR 20): the same "
+        "mixed burst through a front gateway forwarding to a REAL "
+        "`serve --backend continuous --replicas 2 --role "
+        "prefill,decode` subprocess over a remote page-store "
+        "subprocess, federation/propagation ON (X-Trace-Id adoption, "
+        "meta hops, /metrics?fleet=1, /debug/flight?fleet=1) vs OFF "
+        "(--no-fleet-obs both tiers). Gates: ON tok/s within the PR-5 "
+        "dual 2%% band of OFF (loadavg-aware escalation), >= 1 "
+        "cross-process joined trace witnessed in the merged fleet "
+        "export (a peer-process flight event carrying a front-minted "
+        "trace id, monotonic after clock correction), the response "
+        "hop breakdown summing within tolerance of the client-"
+        "measured e2e latency, and byte-identical text across ON/OFF",
+    )
+    p.add_argument(
         "--serve-multi-model",
         action="store_true",
         help="multi-model consensus serving A/B leg (PR 18): a "
@@ -947,6 +964,8 @@ def main() -> int:
         return _bench_serve_fleet_control(args, cfg, params)
     if args.serve_disagg:
         return _bench_serving_disagg(args, cfg, params)
+    if args.serve_fleet_obs:
+        return _bench_serve_fleet_obs(args, cfg, params)
     if args.serve_multi_model:
         return _bench_serving_multimodel(args, cfg, params)
     if args.serve_offload:
@@ -4705,6 +4724,364 @@ def _bench_serving_disagg(args, cfg, params) -> int:
             file=sys.stderr,
         )
     return 0 if status == "ok" else 1
+
+
+def _bench_serve_fleet_obs(args, cfg, params) -> int:
+    """Fleet observability federation overhead A/B (PR 20).
+
+    Topology (three REAL processes): this process runs a front
+    gateway (FakeBackend; every /v1/* forwards) whose one peer is a
+    ``serve --backend continuous --replicas 2 --role prefill,decode``
+    SUBPROCESS whose fleet host tier is a remote page-store
+    subprocess — the full disagg path, crossed by real sockets. Two
+    such stacks boot side by side: federation/propagation ON (the
+    default) and OFF (``--no-fleet-obs`` on the peer, ``fleet_obs=
+    False`` on its front); alternating rounds drive the identical
+    burst through each.
+
+    Gates:
+    - ON tok/s within the PR-5 dual 2% band of OFF (loadavg-aware
+      escalation) — the observability plane must be ~free.
+    - >= 1 cross-process JOINED trace in the merged fleet export: a
+      flight event scraped from the PEER PROCESS carrying a trace id
+      the front minted for one of this burst's requests, and the
+      merged timeline monotone after clock correction.
+    - The ON responses' ``meta["hops"]`` sums track the client-
+      measured e2e latency (median within tolerance).
+    - Byte-identical text across ON/OFF (both peers init the same
+      PRNGKey(0) random weights; observability must not touch
+      sampling).
+    """
+    import json as _json
+    import queue as _queue
+    import re as _re
+    import subprocess
+    import threading as _threading
+
+    from llm_consensus_tpu.backends.fake import FakeBackend
+    from llm_consensus_tpu.server.client import GatewayClient
+    from llm_consensus_tpu.server.gateway import (
+        Gateway,
+        GatewayConfig,
+        GatewayThread,
+    )
+    from llm_consensus_tpu.server.metrics import MetricsRegistry
+
+    pg = 64
+    salt = int(time.time() * 1e6) % 999983
+    header_target = max(args.prompt_len, 2 * pg + 16)
+    header = f"Fleet obs header {salt}: " + "shared context " * (
+        -(-header_target // 15)
+    )
+    n = args.serve_requests
+    prompts = [
+        header + f"Q{i}: item {i * 37 % 101}" for i in range(n // 2)
+    ] + [
+        f"{i} unique {salt}: " + "distinct padding " * 8
+        for i in range(n - n // 2)
+    ]
+
+    def spawn_store():
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "llm_consensus_tpu.serving.remote_store",
+                "--budget-mb",
+                str(max(16, args.serve_host_cache_mb)),
+                "--port",
+                "0",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        try:
+            ep = _json.loads(proc.stdout.readline())["endpoint"]
+        except Exception:
+            proc.kill()
+            return None, None
+        return proc, ep
+
+    def spawn_peer(store_ep: str, fleet_obs: bool):
+        cmd = [
+            sys.executable,
+            "-m",
+            "llm_consensus_tpu",
+            "serve",
+            "--port",
+            "0",
+            "--backend",
+            "continuous",
+            "--model",
+            cfg.name,
+            "--replicas",
+            "2",
+            "--role",
+            "prefill,decode",
+            "--serve-slots",
+            str(args.serve_slots),
+            "--prefill-chunk",
+            str(args.serve_prefill_chunk or 64),
+            "--host-cache-mb",
+            str(max(16, args.serve_host_cache_mb)),
+            "--host-store",
+            store_ep,
+            "--max-new-tokens",
+            str(args.new_tokens),
+        ]
+        if not fleet_obs:
+            cmd.append("--no-fleet-obs")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+
+    def peer_port(proc, tag: str) -> int | None:
+        lines: _queue.Queue = _queue.Queue()
+        _threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True,
+        ).start()
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            try:
+                line = lines.get(timeout=1.0)
+            except _queue.Empty:
+                if proc.poll() is not None:
+                    break
+                continue
+            m = _re.search(r"listening on 127\.0\.0\.1:(\d+)", line)
+            if m:
+                return int(m.group(1))
+        print(
+            f"[bench] {tag} serve subprocess never bound", file=sys.stderr
+        )
+        return None
+
+    stacks: dict[bool, dict] = {}
+    procs: list = []
+    try:
+        for fleet_obs in (True, False):
+            sproc, sep = spawn_store()
+            if sproc is None:
+                print(
+                    "[bench] remote store failed to start",
+                    file=sys.stderr,
+                )
+                return 2
+            procs.append(sproc)
+            stacks[fleet_obs] = {"store": sproc, "store_ep": sep}
+        # Boot both serve subprocesses concurrently (each inits its own
+        # random tiny weights — the slow part), then read both ports.
+        for fleet_obs in (True, False):
+            p = spawn_peer(stacks[fleet_obs]["store_ep"], fleet_obs)
+            procs.append(p)
+            stacks[fleet_obs]["peer"] = p
+        for fleet_obs in (True, False):
+            port = peer_port(
+                stacks[fleet_obs]["peer"],
+                "fleet-obs" if fleet_obs else "no-fleet-obs",
+            )
+            if port is None:
+                return 2
+            url = f"http://127.0.0.1:{port}"
+            stacks[fleet_obs]["peer_url"] = url
+            gw = Gateway(
+                FakeBackend(),
+                config=GatewayConfig(
+                    port=0,
+                    peers=(url,),
+                    fleet_obs=fleet_obs,
+                    peer_timeout_s=600.0,
+                ),
+                registry=MetricsRegistry(),
+            )
+            stacks[fleet_obs]["front"] = GatewayThread(gw).start()
+
+        texts: dict[bool, list] = {True: [], False: []}
+        on_samples: list[tuple[float, dict, str]] = []  # (e2e, hops, tid)
+
+        def leg(tag: str, on: bool) -> float:
+            front = stacks[on]["front"]
+            results: list = [None] * len(prompts)
+
+            def one(i: int, prompt: str) -> None:
+                client = GatewayClient(
+                    "127.0.0.1", front.port, timeout=600.0
+                )
+                t0 = time.perf_counter()
+                try:
+                    r = client.generate(
+                        prompt,
+                        max_new_tokens=args.new_tokens,
+                        temperature=0.0,
+                    )
+                except Exception as e:  # noqa: BLE001 - fails text gate
+                    r = {"num_tokens": 0, "text": f"<error: {e!r}>"}
+                results[i] = (time.perf_counter() - t0, r)
+
+            t0 = time.perf_counter()
+            threads = [
+                _threading.Thread(target=one, args=(i, p))
+                for i, p in enumerate(prompts)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            toks = sum(r["num_tokens"] for _, r in results)
+            texts[on] = [r["text"] for _, r in results]
+            if on:
+                for e2e, r in results:
+                    hops = (r.get("meta") or {}).get("hops") or {}
+                    if hops and r.get("trace_id"):
+                        on_samples.append((e2e, hops, r["trace_id"]))
+            tps = toks / wall
+            print(
+                f"[bench] fleet-obs leg {tag}: {tps:.1f} tok/s "
+                f"({len(prompts)} reqs, {wall:.2f}s)",
+                file=sys.stderr,
+            )
+            return tps
+
+        # One warm-up request per stack first: the peers' cold JIT
+        # compiles must not land inside a timed round asymmetrically.
+        for on in (True, False):
+            GatewayClient(
+                "127.0.0.1", stacks[on]["front"].port, timeout=600.0
+            ).generate(
+                header + " warmup",
+                max_new_tokens=args.new_tokens,
+                temperature=0.0,
+            )
+
+        runs_off, runs_on = _ab_rounds(leg, 2)
+        _ab_escalate(leg, runs_off, runs_on, "serve-fleet-obs")
+        gate_tps = _dual_gate_ok(runs_off, runs_on)
+        text_equal = texts[True] == texts[False]
+
+        # -- joined-trace gate: the merged export must witness a PEER-
+        # process event carrying a front-minted id of this burst ------
+        on_front = stacks[True]["front"]
+        on_peer_url = stacks[True]["peer_url"]
+        fclient = GatewayClient("127.0.0.1", on_front.port, timeout=60.0)
+        merged = fclient._json(
+            "GET", "/debug/flight?fleet=1&limit=100000"
+        )
+        tids = {tid for _, _, tid in on_samples}
+        peer_joined = [
+            e
+            for e in merged["events"]
+            if e.get("host") == on_peer_url and e.get("trace_id") in tids
+        ]
+        t0s = [e["t0"] for e in merged["events"]]
+        monotone = t0s == sorted(t0s)
+        chrome = fclient._json(
+            "GET", "/debug/flight?fleet=1&format=chrome"
+        )
+        chrome_hosts = {
+            ev["args"]["name"]
+            for ev in chrome["traceEvents"]
+            if ev.get("name") == "process_name"
+        }
+        chrome_ok = {"self serving", f"{on_peer_url} serving"} <= (
+            chrome_hosts
+        )
+        gate_join = bool(peer_joined) and monotone and chrome_ok
+
+        # -- hop-sum vs client e2e (median over the ON rounds) --------
+        errs = sorted(
+            abs(sum(h.values()) - e2e) / max(e2e, 1e-9)
+            for e2e, h, _ in on_samples
+        )
+        med_err = errs[len(errs) // 2] if errs else 1.0
+        gate_hops = bool(on_samples) and med_err <= 0.15
+
+        # Federation text view sanity (host= labels from both tiers).
+        fed = fclient._request("GET", "/metrics?fleet=1")[1].decode()
+        fed_ok = 'host="self"' in fed and f'host="{on_peer_url}"' in fed
+
+        status = (
+            "ok"
+            if (
+                gate_tps
+                and gate_join
+                and gate_hops
+                and text_equal
+                and fed_ok
+            )
+            else "failed"
+        )
+        overhead = _paired_overhead_pct(runs_off, runs_on)
+        _emit(
+            {
+                "metric": f"serving tok/s, fleet observability ON "
+                f"({cfg.name}, front->serve[prefill,decode]->store, 3 "
+                f"processes, {n} reqs x {args.new_tokens} tokens; OFF "
+                f"control best {max(runs_off):.1f} tok/s, paired "
+                f"overhead {overhead:.2f}%, joined peer events "
+                f"{len(peer_joined)}, merged monotone={monotone}, "
+                f"hop-sum median err {med_err * 100:.1f}% vs client "
+                f"e2e over {len(on_samples)} reqs, federation "
+                f"host-labels={fed_ok}, text unchanged={text_equal})",
+                "value": round(max(runs_on), 2),
+                "unit": "tokens/sec",
+                "vs_baseline": round(
+                    max(runs_on) / max(max(runs_off), 1e-9), 4
+                ),
+                "status": status,
+            },
+            args.out,
+        )
+        if not gate_tps:
+            print(
+                f"[bench] fleet-obs overhead gate failed: paired "
+                f"{overhead:.2f}%, best ratio "
+                f"{max(runs_on) / max(max(runs_off), 1e-9):.4f}",
+                file=sys.stderr,
+            )
+        if not gate_join:
+            print(
+                f"[bench] joined-trace gate failed: peer events "
+                f"{len(peer_joined)}, monotone={monotone}, "
+                f"chrome hosts={sorted(chrome_hosts)}",
+                file=sys.stderr,
+            )
+        if not gate_hops:
+            print(
+                f"[bench] hop-sum gate failed: median err "
+                f"{med_err * 100:.1f}% over {len(on_samples)} samples",
+                file=sys.stderr,
+            )
+        if not text_equal:
+            print(
+                "[bench] GENERATED TEXT DIVERGED between the fleet-obs "
+                "ON and OFF stacks",
+                file=sys.stderr,
+            )
+        return 0 if status == "ok" else 1
+    finally:
+        for key in (True, False):
+            front = stacks.get(key, {}).get("front")
+            if front is not None:
+                try:
+                    front.drain()
+                except Exception:  # noqa: BLE001 - teardown best effort
+                    pass
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:  # noqa: BLE001 - teardown best effort
+                p.kill()
 
 
 def _bench_serving_offload(args, cfg, params) -> int:

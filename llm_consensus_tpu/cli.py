@@ -889,6 +889,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "cache). The local backend still serves /healthz, /metrics "
         "and debug routes; use --backend fake for a pure front",
     )
+    # Fleet observability (PR 20).
+    p.add_argument(
+        "--no-fleet-obs",
+        action="store_true",
+        help="disable fleet observability federation (PR 20): "
+        "X-Trace-Id propagation/adoption across peer forwards, the "
+        "per-hop meta['hops'] breakdown on /v1/* responses, and the "
+        "/metrics?fleet=1 + /debug/flight?fleet=1 merged views "
+        "(default ON — bench.py --serve-fleet-obs holds the cost "
+        "under the PR-5 2%% tok/s gate)",
+    )
     # Fleet control plane (PR 19).
     p.add_argument(
         "--fleet-control",
@@ -1063,8 +1074,14 @@ def _run_serve(argv: list[str]) -> int:
             ready_stall_s=args.ready_stall_s,
             profile_dir=args.profile_dir,
             peers=tuple(args.peer or ()),
+            fleet_obs=not args.no_fleet_obs,
         ),
     )
+    if fleet_controller is not None:
+        # Burn-rate pressure (PR 20): give the controller a live view
+        # of the admission tier's per-class SLO burn so _steer_elastic
+        # can spawn on sustained burn even before queues deepen.
+        fleet_controller.attach_admission(gateway.admission)
 
     async def _serve() -> None:
         stop = asyncio.Event()

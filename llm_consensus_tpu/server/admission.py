@@ -297,6 +297,20 @@ class AdmissionController:
             "gateway_tenant_shed_total",
             "Fair-share sheds: tenant over its weighted admitted share",
         )
+        # -- PR 20 SLO burn rate: decayed per-class miss fraction
+        # (misses / SLO-classed outcomes over a fair_window_s
+        # half-life window) — 0.0 = the class is meeting its target,
+        # 1.0 = every recent request missed. The fleet controller
+        # reads this through burn_rates(); the gauge and the mirror
+        # update in the same statement blocks (lockstep tested).
+        self._m_burn = reg.gauge(
+            "gateway_slo_burn_rate",
+            "Decayed SLO miss fraction per class (misses over "
+            "SLO-classed outcomes, half-life fair_window_s)",
+        )
+        # class -> [outcomes, misses], both decayed together.
+        self._burn: dict[str, list[float]] = {}
+        self._burn_mark = time.monotonic()
         self._slo_missed: dict[str, int] = {}
         self._slo_sheds = 0
         self._headroom_sum = 0.0
@@ -535,6 +549,37 @@ class AdmissionController:
         self._m_slo_miss.labels(**{"class": label}).inc()
         self._slo_sheds += 1
         self._slo_missed[label] = self._slo_missed.get(label, 0) + 1
+        self._burn_observe(label, missed=True)
+
+    def _burn_observe(self, cls: str, missed: bool) -> None:
+        """Fold one SLO-classed outcome into the class's decayed burn
+        window and refresh the gauge (PR 20). Every SLO outcome site —
+        on-time dispatch, late dispatch, deadline-aware shed — lands
+        here, so the gauge is the live miss fraction, not a counter
+        ratio a scraper has to difference."""
+        now = time.monotonic()
+        dt = now - self._burn_mark
+        self._burn_mark = now
+        w = self.config.fair_window_s
+        if dt > 0 and w > 0:
+            f = 0.5 ** (dt / w)
+            for b in self._burn.values():
+                b[0] *= f
+                b[1] *= f
+        b = self._burn.setdefault(cls, [0.0, 0.0])
+        b[0] += 1.0
+        if missed:
+            b[1] += 1.0
+        self._m_burn.labels(**{"class": cls}).set(b[1] / b[0])
+
+    def burn_rates(self) -> dict[str, float]:
+        """Decayed per-class SLO miss fraction — the gauge's value,
+        readable in-process (the PR-19 FleetController's tick pulls
+        this instead of scraping its own gateway)."""
+        return {
+            cls: (b[1] / b[0] if b[0] > 0 else 0.0)
+            for cls, b in self._burn.items()
+        }
 
     def _shed_would_miss(
         self,
@@ -648,6 +693,7 @@ class AdmissionController:
         against the Prometheus families (same increments, same units)."""
         return {
             "slo_miss": dict(self._slo_missed),
+            "slo_burn_rate": self.burn_rates(),
             "slo_sheds": self._slo_sheds,
             "slo_headroom_sum": self._headroom_sum,
             "slo_headroom_count": self._headroom_count,
@@ -781,15 +827,19 @@ class AdmissionController:
                     else 0.2 * inst + 0.8 * self._rate
                 )
             self._rate_mark = now
-            if item.slo_target is not None and wait > item.slo_target:
-                # The PR-10 wait histogram is now a TARGET: a dispatch
-                # past its class budget is a recorded miss, in both the
-                # Prometheus family and the stats() mirror.
+            if item.slo_target is not None:
                 label = item.slo_class or "default"
-                self._m_slo_miss.labels(**{"class": label}).inc()
-                self._slo_missed[label] = (
-                    self._slo_missed.get(label, 0) + 1
-                )
+                missed = wait > item.slo_target
+                if missed:
+                    # The PR-10 wait histogram is now a TARGET: a
+                    # dispatch past its class budget is a recorded
+                    # miss, in both the Prometheus family and the
+                    # stats() mirror.
+                    self._m_slo_miss.labels(**{"class": label}).inc()
+                    self._slo_missed[label] = (
+                        self._slo_missed.get(label, 0) + 1
+                    )
+                self._burn_observe(label, missed=missed)
             if item.trace is not None:
                 # The admission wait, recorded at dispatch (start
                 # reconstructed in the trace's clock).

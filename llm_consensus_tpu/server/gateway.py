@@ -21,6 +21,10 @@ Routes:
   ``{"question": ..., "max_rounds"?, "seed"?, "priority"?,
   "deadline_s"?}`` and returns answer/rounds/endorsed/author/feedback.
 - ``GET /metrics`` — Prometheus text exposition of the registry.
+  ``?fleet=1`` on a front gateway (PR 20) scrapes every peer's
+  ``/metrics`` and merges the families under a ``host=`` label
+  (``host="self"`` is this process) — federation sums equal the sums
+  of the per-peer scrapes.
 - ``GET /healthz`` — LIVENESS: process up, drain state, backend
   heartbeat ages (always 200 while the process can answer).
 - ``GET /readyz`` — READINESS: 503 while draining or while the
@@ -35,6 +39,11 @@ Routes:
   ``?format=chrome`` as Chrome trace-event JSON loadable in Perfetto
   (device track reconstructed from dispatch→fetch windows, host track
   for un-overlapped scheduler work, one track per request).
+  ``?fleet=1`` (PR 20) merges every peer's ring onto this process's
+  clock (RTT-halving offset estimate from the ``now_pc`` stamp each
+  reply carries); with ``format=chrome`` each host gets its own
+  ``pid`` pair so one forwarded request reads as one aligned lane
+  across processes.
 - ``GET /debug/requests`` — per-request serving summaries (TTFT,
   inter-token-gap percentiles, spec tokens accepted per round,
   restored-vs-prefilled header pages); ``?id=<request or trace id>``
@@ -55,10 +64,14 @@ locally but forwarded to the peer gateway whose ``/debug/chains``
 probe shows the longest resident chain for the prompt (ties and cold
 chains go to the first reachable peer: "move the query, not the
 cache" across hosts). The probe + forward run in the default executor
-(urllib blocks); the peer's response body/status relay verbatim, with
-this front's ``X-Trace-Id`` attached so one trace id follows the
-request across hosts. An unreachable peer is skipped; all peers
-unreachable => 502.
+(urllib blocks); the peer's response body/status relay with this
+front's ``X-Trace-Id`` attached. PR 20 makes that id a PROPAGATED
+context: it rides the forwarded *request* too, the peer *adopts* it
+(its spans join the front's trace), and the front folds its routing
+time into the relayed ``meta["hops"]`` — so one trace id genuinely
+follows the request across hosts and the per-hop breakdown covers the
+whole path. An unreachable peer is skipped; all peers unreachable
+=> 502.
 
 Status mapping: 429 + ``Retry-After`` on shed, 503 + ``Retry-After``
 while draining, 504 on deadline expiry, 502 on backend failure, 400 on
@@ -131,6 +144,64 @@ _REASONS = {
     504: "Gateway Timeout",
 }
 
+#: One Prometheus exposition sample line: name, optional {labels}, value.
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+.*)$")
+
+
+def _metrics_family(name: str, known: dict) -> str:
+    """Family a sample line belongs to: histogram series (`_bucket`/
+    `_sum`/`_count`) group under their base family when its HELP/TYPE
+    header was seen; everything else is its own family."""
+    for suf in ("_bucket", "_sum", "_count"):
+        if name.endswith(suf) and name[: -len(suf)] in known:
+            return name[: -len(suf)]
+    return name
+
+
+def _merge_metrics_text(texts: dict) -> str:
+    """Merge per-host Prometheus expositions under a ``host=`` label
+    (PR 20 federation view). Values relay verbatim — a summed family in
+    the merged view is exactly the sum of the per-host scrapes (the
+    lockstep the federation tests assert). HELP/TYPE headers dedupe to
+    one copy per family; samples group under their family so strict
+    parsers stay happy.
+    """
+    meta_lines: dict[str, list[str]] = {}
+    fam_order: list[str] = []
+    fam_samples: dict[str, list[str]] = {}
+    for host, text in texts.items():
+        for line in text.splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                fam = parts[2] if len(parts) >= 3 else line
+                if fam not in meta_lines:
+                    meta_lines[fam] = []
+                    fam_samples.setdefault(fam, [])
+                    fam_order.append(fam)
+                if line not in meta_lines[fam]:
+                    meta_lines[fam].append(line)
+                continue
+            m = _SAMPLE_RE.match(line)
+            if not m:
+                continue
+            name, labels, value = m.groups()
+            fam = _metrics_family(name, meta_lines)
+            if fam not in fam_samples:
+                fam_samples[fam] = []
+                meta_lines.setdefault(fam, [])
+                fam_order.append(fam)
+            inner = labels[1:-1] if labels else ""
+            merged = f'host="{host}"' + ("," + inner if inner else "")
+            fam_samples[fam].append(f"{name}{{{merged}}} {value}")
+    out: list[str] = []
+    for fam in fam_order:
+        out.extend(meta_lines.get(fam, ()))
+        out.extend(fam_samples.get(fam, ()))
+    return "\n".join(out) + "\n"
+
 
 class GatewayConfig:
     def __init__(
@@ -168,6 +239,13 @@ class GatewayConfig:
         # Budget for one /debug/chains residency probe; a peer that
         # cannot answer this quickly is skipped for this request.
         peer_probe_timeout_s: float = 2.0,
+        # Fleet observability (PR 20): adopt an incoming X-Trace-Id
+        # as this process's trace id (child spans join the front's
+        # trace instead of rooting a fresh one), attach the per-hop
+        # breakdown to response ``meta["hops"]``, and serve the
+        # ``/metrics?fleet=1`` / ``/debug/flight?fleet=1`` federation
+        # views. The bench's ``--serve-fleet-obs`` A/B lever.
+        fleet_obs: bool = True,
     ):
         self.host = host
         self.port = port
@@ -182,6 +260,7 @@ class GatewayConfig:
         self.peers = tuple(p.rstrip("/") for p in peers)
         self.peer_timeout_s = peer_timeout_s
         self.peer_probe_timeout_s = peer_probe_timeout_s
+        self.fleet_obs = bool(fleet_obs)
 
 
 class Gateway:
@@ -242,6 +321,17 @@ class Gateway:
             "Generated tokens per second of request wall-clock",
             buckets=_metrics.THROUGHPUT_BUCKETS,
         )
+        self._m_hops = reg.histogram(
+            "gateway_hop_seconds",
+            "Per-hop request time attribution (PR 20): front_route, "
+            "admission_wait, prefill, handoff, wire_transfer, decode",
+        )
+        # Best clock-offset estimate per peer host (PR 20):
+        # host -> (offset_s, rtt_s); min-RTT wins (NTP-style — the
+        # tightest round trip bounds the midpoint error). Fed
+        # opportunistically by every /debug/chains routing probe and
+        # fleet scrape that sees a peer ``now_pc`` stamp.
+        self._peer_offsets: dict[str, tuple[float, float]] = {}
 
     # -- lifecycle ------------------------------------------------------
 
@@ -488,11 +578,7 @@ class Gateway:
             await self._handle_chains(rawq, writer)
             return
         if path == "/metrics" and method == "GET":
-            text = self.registry.render().encode()
-            await self._respond_raw(
-                writer, 200, text, "text/plain; version=0.0.4; charset=utf-8"
-            )
-            self._count(path, 200)
+            await self._handle_metrics(rawq, writer)
             return
         if path in ("/v1/generate", "/v1/consensus"):
             if method != "POST":
@@ -510,7 +596,9 @@ class Gateway:
                 self._count(path, 400)
                 return
             if self.config.peers:
-                await self._handle_peer_forward(path, payload, body, writer)
+                await self._handle_peer_forward(
+                    path, payload, body, writer, headers
+                )
                 return
             if path == "/v1/generate":
                 await self._handle_generate(payload, headers, writer)
@@ -559,6 +647,90 @@ class Gateway:
         )
         self._count("/debug/traces", 200)
 
+    async def _handle_metrics(self, rawq: str, writer) -> None:
+        """``GET /metrics``: Prometheus text exposition. With
+        ``?fleet=1`` on a front gateway (PR 20): scrape every peer's
+        ``/metrics`` concurrently and merge the families under a
+        ``host=`` label (``host="self"`` for this process) — sums over
+        the merged view equal the sums of the per-peer scrapes."""
+        from urllib.parse import parse_qs
+
+        q = parse_qs(rawq)
+        if (
+            self.config.fleet_obs
+            and (q.get("fleet") or [""])[0] in ("1", "true")
+        ):
+            texts = {"self": self.registry.render()}
+            loop = asyncio.get_running_loop()
+            if self.config.peers:
+                fetched = await asyncio.gather(
+                    *(
+                        loop.run_in_executor(
+                            None,
+                            self._fetch_peer_text,
+                            f"{p}/metrics",
+                            self.config.peer_probe_timeout_s,
+                        )
+                        for p in self.config.peers
+                    ),
+                    return_exceptions=True,
+                )
+                for peer, got in zip(self.config.peers, fetched):
+                    if isinstance(got, str):
+                        texts[peer] = got
+            await self._respond_raw(
+                writer,
+                200,
+                _merge_metrics_text(texts).encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            self._count("/metrics", 200)
+            return
+        text = self.registry.render().encode()
+        await self._respond_raw(
+            writer, 200, text, "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self._count("/metrics", 200)
+
+    def _fetch_peer_text(self, url: str, timeout: float) -> str:
+        """Blocking GET returning a peer's raw text body (executor
+        only)."""
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode("utf-8", "replace")
+
+    def _fetch_peer_json(self, url: str, timeout: float):
+        """Blocking GET returning ``(doc, t_send_pc, t_recv_pc)`` —
+        the perf_counter stamps bracketing the exchange feed the
+        RTT-halving clock-offset estimate (executor only)."""
+        import urllib.request
+
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            doc = json.loads(r.read())
+        return doc, t0, time.perf_counter()
+
+    @staticmethod
+    def _clock_offset(doc: dict, t_send: float, t_recv: float):
+        """Midpoint clock-offset estimate from a reply carrying the
+        peer's ``now_pc`` perf_counter stamp: assuming the reply was
+        stamped mid-flight, ``t_local ≈ t_peer + offset`` with
+        ``offset = (t_send + t_recv)/2 − now_pc``. Returns
+        ``(offset_s, rtt_s)`` or ``(None, None)`` when the peer
+        predates the stamp."""
+        now = doc.get("now_pc")
+        if not isinstance(now, (int, float)):
+            return None, None
+        return (t_send + t_recv) / 2.0 - float(now), t_recv - t_send
+
+    def _note_offset(self, host: str, offset, rtt) -> None:
+        if offset is None:
+            return
+        cur = self._peer_offsets.get(host)
+        if cur is None or rtt <= cur[1]:
+            self._peer_offsets[host] = (float(offset), float(rtt))
+
     async def _handle_flight(self, rawq: str, writer) -> None:
         """``GET /debug/flight``: the flight recorder's event ring
         (PR 10). ``?format=chrome`` renders Chrome trace-event JSON
@@ -573,6 +745,12 @@ class Gateway:
         from llm_consensus_tpu.serving import flight as _flight
 
         q = parse_qs(rawq)
+        if (
+            self.config.fleet_obs
+            and (q.get("fleet") or [""])[0] in ("1", "true")
+        ):
+            await self._handle_flight_fleet(q, writer)
+            return
         rec = _flight.flight_recorder()
         events = rec.events()
         raw_limit = (q.get("limit") or [None])[0]
@@ -602,9 +780,94 @@ class Gateway:
                 "capacity": rec.capacity,
                 "dropped": rec.dropped,
                 "n_events": len(events),
+                # Clock-probe stamp (PR 20): a scraping front halves
+                # the exchange's RTT around this to place our
+                # perf_counter timebase on its own.
+                "now_pc": time.perf_counter(),
                 "events": [
                     e.to_dict()
                     for e in (events[-limit:] if limit > 0 else [])
+                ],
+            },
+        )
+        self._count("/debug/flight", 200)
+
+    async def _handle_flight_fleet(self, q: dict, writer) -> None:
+        """``GET /debug/flight?fleet=1`` (PR 20): merged cross-process
+        flight timeline. Scrapes every peer's ``/debug/flight``
+        concurrently, estimates each peer's clock offset from the
+        ``now_pc`` stamp riding the reply (RTT-halving midpoint;
+        min-RTT estimate wins across probes), and merges the rings
+        onto this process's perf_counter timebase. ``?format=chrome``
+        renders one ``pid`` pair per host so a forwarded request reads
+        as one aligned lane across processes."""
+        from llm_consensus_tpu.serving import flight as _flight
+
+        loop = asyncio.get_running_loop()
+        own = _flight.flight_recorder().events()
+        by_host: dict = {"self": (own, 0.0)}
+        hosts_doc: dict = {"self": {"offset_s": 0.0, "rtt_s": 0.0}}
+        unreachable: list[str] = []
+        if self.config.peers:
+            fetched = await asyncio.gather(
+                *(
+                    loop.run_in_executor(
+                        None,
+                        self._fetch_peer_json,
+                        f"{p}/debug/flight?limit=100000",
+                        self.config.peer_probe_timeout_s,
+                    )
+                    for p in self.config.peers
+                ),
+                return_exceptions=True,
+            )
+            for peer, got in zip(self.config.peers, fetched):
+                if isinstance(got, BaseException):
+                    unreachable.append(peer)
+                    continue
+                doc, t0, t1 = got
+                off, rtt = self._clock_offset(doc, t0, t1)
+                self._note_offset(peer, off, rtt)
+                best = self._peer_offsets.get(peer)
+                offset = best[0] if best else 0.0
+                evs = [
+                    _flight.FlightEvent(
+                        seq=int(e.get("seq", 0)),
+                        kind=str(e.get("kind", "?")),
+                        t0=float(e.get("t0", 0.0)),
+                        dur=float(e.get("dur_s", 0.0)),
+                        trace_id=e.get("trace_id"),
+                        meta=e.get("meta") or {},
+                    )
+                    for e in doc.get("events", ())
+                    if isinstance(e, dict)
+                ]
+                by_host[peer] = (evs, offset)
+                hosts_doc[peer] = {
+                    "offset_s": round(offset, 6),
+                    "rtt_s": round(best[1], 6) if best else None,
+                }
+        if (q.get("format") or [""])[0] == "chrome":
+            await self._respond_json(
+                writer, 200, _flight.to_chrome_fleet(by_host)
+            )
+            self._count("/debug/flight", 200)
+            return
+        merged = _flight.merge_fleet(by_host)
+        try:
+            limit = int((q.get("limit") or ["512"])[0])
+        except ValueError:
+            limit = 512
+        await self._respond_json(
+            writer,
+            200,
+            {
+                "hosts": hosts_doc,
+                "unreachable": unreachable,
+                "n_events": len(merged),
+                "events": [
+                    {**e.to_dict(), "host": e.meta.get("host")}
+                    for e in (merged[-limit:] if limit > 0 else [])
                 ],
             },
         )
@@ -696,7 +959,14 @@ class Gateway:
             self._count("/debug/chains", 400)
             return
         doc = await loop.run_in_executor(None, probe, ids)
-        await self._respond_json(writer, 200, {"n_ids": len(ids), **doc})
+        # ``now_pc`` (PR 20): clock-probe stamp piggybacked on the
+        # residency probe — the front halves the probe's RTT around it
+        # to estimate this host's perf_counter offset for free.
+        await self._respond_json(
+            writer,
+            200,
+            {"n_ids": len(ids), "now_pc": time.perf_counter(), **doc},
+        )
         self._count("/debug/chains", 200)
 
     # -- cross-host peer tier (PR 16) -----------------------------------
@@ -713,10 +983,16 @@ class Gateway:
             f"{urllib.parse.quote(prompt, safe='')}"
         )
         try:
+            t_send = time.perf_counter()
             with urllib.request.urlopen(
                 url, timeout=self.config.peer_probe_timeout_s
             ) as r:
                 doc = json.loads(r.read())
+            t_recv = time.perf_counter()
+            # Clock-offset piggyback (PR 20): every routing probe that
+            # reaches a peer refines its offset estimate for free.
+            off, rtt = self._clock_offset(doc, t_send, t_recv)
+            self._note_offset(peer, off, rtt)
             return max(
                 int(doc.get("registry_tokens", 0)),
                 int(doc.get("host_tokens", 0)),
@@ -757,17 +1033,28 @@ class Gateway:
             )
 
     async def _handle_peer_forward(
-        self, path: str, payload: dict, body: bytes, writer
+        self, path: str, payload: dict, body: bytes, writer, headers=None
     ) -> None:
         """Front-gateway routing (PR 16): probe every peer's
         ``/debug/chains`` for this prompt concurrently, forward the
         request to the one with the longest resident chain (first
-        reachable on ties/cold), relay its response verbatim. All
-        blocking I/O runs in the executor; the loop never waits on a
-        socket."""
+        reachable on ties/cold), relay its response. All blocking I/O
+        runs in the executor; the loop never waits on a socket.
+
+        Trace propagation (PR 20): ``X-Trace-Id`` rides the forwarded
+        REQUEST (not just the relayed response) and the peer adopts it
+        — one id genuinely follows the request across hosts, so the
+        front's route spans and the peer's serving spans join under
+        the same trace in the merged fleet export. A chained front
+        adopts an incoming id the same way. The front also injects its
+        own ``front_route`` hop (probe + routing decision time) into
+        the relayed response's ``meta["hops"]``."""
         prompt = payload.get("prompt") or payload.get("question") or ""
-        trace = _tracing.trace_store().start(path, route=path)
+        trace = _tracing.trace_store().start(
+            path, route=path, trace_id=self._incoming_tid(headers)
+        )
         tid = trace.trace_id if trace is not None else None
+        t_start = time.monotonic()
         loop = asyncio.get_running_loop()
         try:
             if isinstance(prompt, str) and prompt:
@@ -798,6 +1085,18 @@ class Gateway:
                 )
                 self._count(path, 502)
                 return
+            t_fwd = time.monotonic()
+            if trace is not None:
+                # The routing decision's span: probe fan-out + ranking.
+                # (Span stamps live in perf_counter space — backdate
+                # the start by the measured monotonic duration.)
+                route_s = t_fwd - t_start
+                trace.add_span(
+                    "front_route",
+                    time.perf_counter() - route_s,
+                    route_s,
+                    peer=peer,
+                )
             try:
                 status, out, ctype = await loop.run_in_executor(
                     None, self._forward_peer, peer, path, body, tid
@@ -811,6 +1110,9 @@ class Gateway:
                 )
                 self._count(path, 502)
                 return
+            out = self._inject_front_hop(
+                status, out, ctype, t_fwd - t_start
+            )
             hdrs = {"X-Peer": peer}
             if tid:
                 hdrs["X-Trace-Id"] = tid
@@ -819,6 +1121,34 @@ class Gateway:
         finally:
             if trace is not None:
                 trace.finish()
+
+    def _inject_front_hop(
+        self, status: int, out: bytes, ctype: str, front_s: float
+    ) -> bytes:
+        """Fold this front's routing time into the relayed response's
+        ``meta["hops"]`` (PR 20) so the client-visible hop breakdown
+        covers the WHOLE path, front included. Only a parseable 200
+        JSON body is touched — anything else relays verbatim."""
+        if not (
+            self.config.fleet_obs
+            and status == 200
+            and "json" in (ctype or "")
+        ):
+            return out
+        try:
+            doc = json.loads(out)
+            if not isinstance(doc, dict):
+                return out
+            meta = doc.get("meta") or {}
+            hops = {
+                "front_route": round(front_s, 6),
+                **(meta.get("hops") or {}),
+            }
+            doc["meta"] = {**meta, "hops": hops}
+            self._m_hops.labels(hop="front_route").observe(front_s)
+            return json.dumps(doc).encode()
+        except Exception:  # noqa: BLE001 - relay verbatim on any doubt
+            return out
 
     @staticmethod
     def _shed_reason(e: Exception) -> str:
@@ -894,6 +1224,81 @@ class Gateway:
     def _trace_id() -> str | None:
         trace = _tracing.current_trace()
         return trace.trace_id if trace is not None else None
+
+    def _incoming_tid(self, headers) -> str | None:
+        """The ``X-Trace-Id`` a forwarding front attached (PR 20) —
+        adopting it roots this process's spans under the front's trace
+        id instead of minting a fresh root. None when fleet
+        observability is off or no id arrived; the trace store
+        validates the id's shape before adopting."""
+        if not self.config.fleet_obs or not headers:
+            return None
+        return headers.get("x-trace-id")
+
+    def _hop_breakdown(self, trace, meta, dt: float) -> dict | None:
+        """Per-hop time attribution for one request (PR 20), sourced
+        from the joined trace spans plus the batcher's summary meta:
+
+        - ``admission_wait`` — the admission queue's "queued" span(s);
+        - ``prefill`` / ``decode`` — split from the serving summary's
+          ``ttft_s`` / ``duration_s`` when the backend records one,
+          else the admission "execute" span stands in for ``decode``;
+        - ``handoff`` — disagg claim→export→restore spans;
+        - ``wire_transfer`` — remote-store ``store_op`` spans.
+
+        A forwarding front prepends ``front_route`` at relay time
+        (:meth:`_inject_front_hop`). For a single-generation request
+        the hop sum tracks the client-observed latency (the e2e
+        tolerance the fleet-obs bench gates); a consensus fan-out's
+        spans overlap, so there the breakdown is attribution, not a
+        wall-clock identity. Each hop lands in the
+        ``gateway_hop_seconds{hop=}`` histogram."""
+        if not self.config.fleet_obs or trace is None:
+            return None
+        sums: dict[str, float] = {}
+        for s in trace.spans():
+            if s.name == "queued":
+                sums["admission_wait"] = (
+                    sums.get("admission_wait", 0.0) + s.duration
+                )
+            elif s.name == "handoff":
+                sums["handoff"] = sums.get("handoff", 0.0) + s.duration
+            elif s.name == "store_op":
+                sums["wire_transfer"] = (
+                    sums.get("wire_transfer", 0.0) + s.duration
+                )
+            elif s.name == "execute":
+                sums["execute"] = sums.get("execute", 0.0) + s.duration
+        hops: dict[str, float] = {}
+        timing = meta if isinstance(meta, dict) else {}
+        ttft = timing.get("ttft_s")
+        dur = timing.get("duration_s")
+        if isinstance(ttft, (int, float)):
+            hops["prefill"] = float(ttft)
+            if isinstance(dur, (int, float)) and dur >= ttft:
+                hops["decode"] = float(dur) - float(ttft)
+        elif "execute" in sums:
+            # No serving summary (e.g. a FakeBackend): the execute
+            # span IS the backend time; call it decode rather than
+            # invent a prefill split the backend never measured.
+            hops["decode"] = sums["execute"]
+        if "handoff" in sums and "wire_transfer" in sums:
+            # Store-op spans nest INSIDE the handoff window (the
+            # coordinator's claim→export→restore wraps the page
+            # put/get): report handoff net of its wire time so the
+            # hop sum stays a partition, not a double count.
+            sums["handoff"] = max(
+                0.0, sums["handoff"] - sums["wire_transfer"]
+            )
+        for key in ("admission_wait", "handoff", "wire_transfer"):
+            if key in sums:
+                hops[key] = sums[key]
+        if not hops:
+            return None
+        hops = {k: round(v, 6) for k, v in hops.items()}
+        for k, v in hops.items():
+            self._m_hops.labels(hop=k).observe(v)
+        return hops
 
     def _sampling_from(self, payload: dict) -> SamplingParams:
         d = self.config.sampling
@@ -1012,7 +1417,11 @@ class Gateway:
         # spans through the contextvars protocol or explicit trace
         # handles (None when tracing is disabled: every site no-ops).
         trace = _tracing.trace_store().start(
-            "/v1/generate", route="/v1/generate"
+            "/v1/generate",
+            route="/v1/generate",
+            # Adopt a forwarding front's id (PR 20): this process's
+            # spans join the front's trace instead of rooting anew.
+            trace_id=self._incoming_tid(headers),
         )
         # Route-driven restore prefetch (PR 17): the destination is
         # decided (single-replica backends) or about to be (the fleet
@@ -1069,6 +1478,15 @@ class Gateway:
         self._observe_generation(dt, dt, result.num_tokens)
         tid = trace.trace_id if trace is not None else None
         meta = getattr(result, "meta", None)
+        hops = self._hop_breakdown(trace, meta, dt)
+        if hops:
+            # Fold IN PLACE when the backend handed us its RequestLog
+            # summary (same dict object) — /debug/requests must serve
+            # the identical doc the response meta carries.
+            if isinstance(meta, dict):
+                meta["hops"] = hops
+            else:
+                meta = {"hops": hops}
         await self._respond_json(
             writer,
             200,
@@ -1178,6 +1596,14 @@ class Gateway:
             self._m_ttft.observe(dt)
         self._observe_generation(None, dt, result.num_tokens)
         meta = getattr(result, "meta", None)
+        hops = self._hop_breakdown(_tracing.current_trace(), meta, dt)
+        if hops:
+            # In place for the same /debug/requests identity as the
+            # buffered path.
+            if isinstance(meta, dict):
+                meta["hops"] = hops
+            else:
+                meta = {"hops": hops}
         await self._sse_event(
             writer,
             {
@@ -1260,7 +1686,9 @@ class Gateway:
             self._count("/v1/consensus", 400)
             return
         trace = _tracing.trace_store().start(
-            "/v1/consensus", route="/v1/consensus"
+            "/v1/consensus",
+            route="/v1/consensus",
+            trace_id=self._incoming_tid(headers),
         )
         t0 = time.monotonic()
 
@@ -1293,6 +1721,10 @@ class Gateway:
         self._m_ttft.observe(dt)
         self._m_latency.observe(dt)
         tid = trace.trace_id if trace is not None else None
+        # A panel fan-out's spans overlap, so the hop breakdown here
+        # is attribution (where the panel's time went), not a
+        # wall-clock partition like the single-generation paths.
+        hops = self._hop_breakdown(trace, None, dt)
         await self._respond_json(
             writer,
             200,
@@ -1303,6 +1735,7 @@ class Gateway:
                 "author": result.author,
                 "feedback": {k: v.value for k, v in result.feedback.items()},
                 "trace_id": tid,
+                **({"meta": {"hops": hops}} if hops else {}),
             },
             {"X-Trace-Id": tid} if tid else None,
         )

@@ -995,6 +995,13 @@ INSTANCE_FAMILIES: dict[str, str] = {
     "gateway_slo_headroom_seconds": "histogram",
     "gateway_tenant_cost_bytes": "counter",
     "gateway_tenant_shed_total": "counter",
+    # PR 20 fleet observability: per-hop request attribution sourced
+    # from the joined trace spans (labeled ``hop="front_route"|
+    # "admission_wait"|"prefill"|"handoff"|"wire_transfer"|"decode"``),
+    # and the admission controller's decayed per-``class`` SLO miss
+    # fraction the FleetController reads through burn_rates().
+    "gateway_hop_seconds": "histogram",
+    "gateway_slo_burn_rate": "gauge",
 }
 
 

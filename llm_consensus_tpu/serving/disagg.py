@@ -63,6 +63,7 @@ from llm_consensus_tpu.server.metrics import (
 from llm_consensus_tpu.server.metrics import ROLE_HANDOFFS as _M_HANDOFFS
 from llm_consensus_tpu.serving import flight as _flight
 from llm_consensus_tpu.serving.continuous import ContinuousConfig
+from llm_consensus_tpu.utils import tracing as _tracing
 
 log = logging.getLogger(__name__)
 
@@ -180,13 +181,20 @@ class HandoffCoordinator:
             return True
         return False
 
-    def ensure_prefilled(self, prompt: str, ids, chain) -> bool:
+    def ensure_prefilled(self, prompt: str, ids, chain, trace=None) -> bool:
         """Warm-and-export a cold chain through a prefill replica.
         Returns True when a handoff was INITIATED (completed inline
         off-loop; running on a daemon thread on the event loop).
         No-ops — cheap probes only — when the chain is too short, has
         a live claim, is already resident on a decode replica, or is
-        already restorable from the fleet store."""
+        already restorable from the fleet store.
+
+        ``trace`` (PR 20): the owning request's trace. The handoff
+        worker runs UNDER it (``use_trace``), so the claim→export→
+        restore window lands as a ``handoff`` span on the request's
+        trace, the store client's ops inside it carry the id on the
+        wire, and the ``handoff`` flight event joins the merged fleet
+        timeline by the same id."""
         fleet = self.fleet
         page = fleet.config.page_size
         if not chain or len(ids) <= page:
@@ -239,19 +247,26 @@ class HandoffCoordinator:
 
         def finish() -> None:
             try:
-                fut.result(timeout=wait_s)
-                if ev_stream is not None:
-                    ev = ev_stream
-                else:
-                    ev = fleet.batchers[src].request_export(ids)
-                if not ev.wait(max(0.0, deadline - time.monotonic())):
-                    log.warning(
-                        "handoff export from replica %d did not land "
-                        "within %.1fs; decode side may re-prefill",
-                        src,
-                        wait_s,
-                    )
-                    return
+                # The handoff worker runs under the owning request's
+                # trace (PR 20): store ops issued from THIS thread
+                # attach their spans here and carry the id on the wire.
+                with _tracing.use_trace(trace):
+                    fut.result(timeout=wait_s)
+                    if ev_stream is not None:
+                        ev = ev_stream
+                    else:
+                        ev = fleet.batchers[src].request_export(ids)
+                    if not ev.wait(
+                        max(0.0, deadline - time.monotonic())
+                    ):
+                        log.warning(
+                            "handoff export from replica %d did not "
+                            "land within %.1fs; decode side may "
+                            "re-prefill",
+                            src,
+                            wait_s,
+                        )
+                        return
             except Exception as e:  # noqa: BLE001 - degrade, never wedge
                 log.warning("handoff via replica %d failed: %s", src, e)
                 return
@@ -265,10 +280,15 @@ class HandoffCoordinator:
                 self.handoffs += 1
                 self.handoff_seconds_sum += dur
                 self.handoff_seconds_count += 1
+            if trace is not None:
+                trace.add_span(
+                    "handoff", t0, dur, src=src, chain_pages=len(chain)
+                )
             _flight.flight_recorder().record(
                 "handoff",
                 t0,
                 dur,
+                trace_id=_tracing.trace_id_of(trace),
                 src=src,
                 chain_pages=len(chain),
                 streamed=streamed,

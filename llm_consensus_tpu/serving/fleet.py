@@ -105,6 +105,7 @@ from llm_consensus_tpu.server.metrics import (
     ROUTER_WEIGHT as _M_ROUTER_WEIGHT,
 )
 from llm_consensus_tpu.serving import flight as _flight
+from llm_consensus_tpu.utils import tracing as _tracing
 from llm_consensus_tpu.serving.continuous import (
     ContinuousBatcher,
     ContinuousConfig,
@@ -662,7 +663,12 @@ class ReplicaSet:
             # Role split (PR 16): a cold chain warms on a prefill
             # replica and lands in the shared store before (off-loop)
             # or while (on the gateway loop) the real request decodes.
-            self.handoff.ensure_prefilled(prompt, ids, chain)
+            # The submit path runs under the request's trace (PR 20):
+            # hand it through so the claim→export→restore window and
+            # the store ops inside it attribute to THIS request.
+            self.handoff.ensure_prefilled(
+                prompt, ids, chain, trace=_tracing.current_trace()
+            )
         idx, reason = self.router.route(ids, chain=chain)
         self._count_route(idx, reason, chain)
         if self.fleet_config.prefetch and self.store is not None:
@@ -694,6 +700,7 @@ class ReplicaSet:
         _flight.flight_recorder().record(
             "route",
             time.perf_counter(),
+            trace_id=_tracing.trace_id_of(_tracing.current_trace()),
             replica=idx,
             reason=reason,
             chain_pages=len(chain),

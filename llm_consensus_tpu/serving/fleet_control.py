@@ -143,6 +143,13 @@ class FleetControlConfig:
     retire_idle_ticks: int = 20
     #: Drain bound handed to ReplicaSet.retire_replica.
     retire_wait_s: float = 60.0
+    #: SLO burn-rate spawn pressure (PR 20): when an attached
+    #: admission controller reports any class's decayed miss fraction
+    #: (``gateway_slo_burn_rate{class=}``) at or above this, the tick
+    #: counts as spawn pressure even if queue depth looks calm —
+    #: misses can burn while depth oscillates under the spawn_depth
+    #: threshold. 1.0 < never (burn is a fraction).
+    burn_spawn_threshold: float = 0.5
 
     def admission_kwargs(self) -> dict:
         """The AdmissionConfig field overrides this fleet config
@@ -184,9 +191,35 @@ class FleetController:
         self._restore_cap: int | None = None
         self._spawn_streak = 0
         self._idle_streak = 0
+        #: The gateway admission controller this fleet serves behind
+        #: (PR 20): attached by the CLI after the gateway is built, it
+        #: feeds the per-class SLO burn rates into elastic decisions.
+        self.admission = None
         # Discoverability: stats/bench surfaces reach the controller
         # through the fleet they already hold.
         replicas.fleet_controller = self
+
+    def attach_admission(self, admission) -> None:
+        """Wire the gateway's admission controller in (PR 20) so each
+        tick can read its decayed per-class SLO burn rates
+        (:meth:`~llm_consensus_tpu.server.admission.
+        AdmissionController.burn_rates`, the
+        ``gateway_slo_burn_rate{class=}`` mirror) as spawn pressure."""
+        self.admission = admission
+
+    def burn_rates(self) -> dict:
+        """Per-class decayed SLO miss fractions from the attached
+        admission controller; empty when none is attached (the
+        pre-PR-20 shape — every decision then falls back to
+        depth-only signals)."""
+        adm = self.admission
+        if adm is None:
+            return {}
+        try:
+            return dict(adm.burn_rates())
+        except Exception:  # noqa: BLE001 - telemetry must not kill ticks
+            log.exception("burn-rate read failed")
+            return {}
 
     # -- lifecycle ------------------------------------------------------
 
@@ -247,7 +280,9 @@ class FleetController:
             self._steer_group_cap(bs, max_slots, pressure)
             self._steer_restore_cap(rs, bs, pressure)
         if cfg.elastic_max > 0:
-            self._steer_elastic(rs, serving, depths, actives)
+            self._steer_elastic(
+                rs, serving, depths, actives, self.burn_rates()
+            )
 
     def _steer_weights(self, rs, serving, loads) -> None:
         cfg = self.config
@@ -316,10 +351,19 @@ class FleetController:
                 debt_frac=round(frac, 3),
             )
 
-    def _steer_elastic(self, rs, serving, depths, actives) -> None:
+    def _steer_elastic(self, rs, serving, depths, actives, burn) -> None:
         cfg = self.config
         mean_depth = sum(depths) / len(serving)
-        if mean_depth >= cfg.spawn_depth and len(serving) < cfg.elastic_max:
+        # Burn-rate pressure (PR 20): a class burning SLO misses is
+        # demand the depth signal can miss (depth oscillates under
+        # spawn_depth while would-miss sheds keep it artificially
+        # low) — count it toward the same sustain streak.
+        burning = (
+            max(burn.values(), default=0.0) >= cfg.burn_spawn_threshold
+        )
+        if (
+            mean_depth >= cfg.spawn_depth or burning
+        ) and len(serving) < cfg.elastic_max:
             self._spawn_streak += 1
             if self._spawn_streak >= cfg.spawn_sustain_ticks:
                 self._spawn_streak = 0
@@ -328,6 +372,7 @@ class FleetController:
                     "spawn",
                     replica=idx,
                     mean_depth=round(mean_depth, 2),
+                    burning=burning,
                 )
         else:
             self._spawn_streak = 0
@@ -378,4 +423,5 @@ class FleetController:
         out["fleet_restore_cap"] = (
             self._restore_cap if self._restore_cap is not None else -1
         )
+        out["fleet_burn_rate"] = self.burn_rates()
         return out

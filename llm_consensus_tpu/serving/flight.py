@@ -59,6 +59,8 @@ __all__ = [
     "enabled",
     "percentile",
     "to_chrome",
+    "merge_fleet",
+    "to_chrome_fleet",
 ]
 
 
@@ -309,6 +311,147 @@ _TID_SCHED = 3
 _PID_REQUESTS = 2
 
 
+def _emit_process_meta(
+    out: list[dict],
+    pid_serving: int,
+    pid_requests: int,
+    serving_name: str,
+    requests_name: str,
+) -> None:
+    """Process/thread metadata rows for one host's pid pair."""
+    out.append(
+        {
+            "ph": "M",
+            "ts": 0,
+            "pid": pid_serving,
+            "tid": 0,
+            "name": "process_name",
+            "args": {"name": serving_name},
+        }
+    )
+    out.append(
+        {
+            "ph": "M",
+            "ts": 0,
+            "pid": pid_requests,
+            "tid": 0,
+            "name": "process_name",
+            "args": {"name": requests_name},
+        }
+    )
+    for tid, name in (
+        (_TID_DEVICE, "device programs"),
+        (_TID_HOST, "host (un-overlapped)"),
+        (_TID_SCHED, "scheduler events"),
+    ):
+        out.append(
+            {
+                "ph": "M",
+                "ts": 0,
+                "pid": pid_serving,
+                "tid": tid,
+                "name": "thread_name",
+                "args": {"name": name},
+            }
+        )
+
+
+def _emit_events(
+    out: list[dict],
+    events: list[FlightEvent],
+    base: float,
+    pid_serving: int,
+    pid_requests: int,
+) -> None:
+    """Emit one host's flight events against a shared time base."""
+
+    def us(t: float) -> float:
+        return round((t - base) * 1e6, 3)
+
+    req_tids: dict[str, int] = {}
+    for e in events:
+        args = dict(e.meta)
+        if e.trace_id is not None:
+            args["trace_id"] = e.trace_id
+        if e.kind == "program":
+            out.append(
+                {
+                    "name": args.get("kind", "program"),
+                    "cat": "device",
+                    "ph": "X",
+                    "ts": us(e.t0),
+                    "dur": round(e.dur * 1e6, 3),
+                    "pid": pid_serving,
+                    "tid": _TID_DEVICE,
+                    "args": args,
+                }
+            )
+        elif e.kind == "host":
+            out.append(
+                {
+                    "name": "sched_host",
+                    "cat": "host",
+                    "ph": "X",
+                    "ts": us(e.t0),
+                    "dur": round(e.dur * 1e6, 3),
+                    "pid": pid_serving,
+                    "tid": _TID_HOST,
+                    "args": args,
+                }
+            )
+        elif e.kind == "request":
+            rid = str(args.get("id", e.trace_id or e.seq))
+            tid = req_tids.setdefault(rid, len(req_tids) + 1)
+            out.append(
+                {
+                    "ph": "M",
+                    "ts": 0,
+                    "pid": pid_requests,
+                    "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": rid},
+                }
+            )
+            out.append(
+                {
+                    "name": rid,
+                    "cat": "request",
+                    "ph": "X",
+                    "ts": us(e.t0),
+                    "dur": round(e.dur * 1e6, 3),
+                    "pid": pid_requests,
+                    "tid": tid,
+                    "args": args,
+                }
+            )
+        elif e.dur > 0:
+            out.append(
+                {
+                    "name": e.kind,
+                    "cat": "scheduler",
+                    "ph": "X",
+                    "ts": us(e.t0),
+                    "dur": round(e.dur * 1e6, 3),
+                    "pid": pid_serving,
+                    "tid": _TID_SCHED,
+                    "args": args,
+                }
+            )
+        else:
+            out.append(
+                {
+                    "name": e.kind,
+                    "cat": "scheduler",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": us(e.t0),
+                    "pid": pid_serving,
+                    "tid": _TID_SCHED,
+                    "args": args,
+                }
+            )
+
+
 def to_chrome(events: list[FlightEvent]) -> dict:
     """Chrome trace-event JSON from a flight-ring snapshot.
 
@@ -327,126 +470,88 @@ def to_chrome(events: list[FlightEvent]) -> dict:
     Request-span events (``kind == "request"``, recorded at
     retirement) each get their own thread row named by request id.
     """
-    out: list[dict] = [
-        {
-            "ph": "M",
-            "ts": 0,
-            "pid": _PID_SERVING,
-            "tid": 0,
-            "name": "process_name",
-            "args": {"name": "serving"},
-        },
-        {
-            "ph": "M",
-            "ts": 0,
-            "pid": _PID_REQUESTS,
-            "tid": 0,
-            "name": "process_name",
-            "args": {"name": "requests"},
-        },
-    ]
-    for tid, name in (
-        (_TID_DEVICE, "device programs"),
-        (_TID_HOST, "host (un-overlapped)"),
-        (_TID_SCHED, "scheduler events"),
-    ):
-        out.append(
-            {
-                "ph": "M",
-                "ts": 0,
-                "pid": _PID_SERVING,
-                "tid": tid,
-                "name": "thread_name",
-                "args": {"name": name},
-            }
+    out: list[dict] = []
+    _emit_process_meta(
+        out, _PID_SERVING, _PID_REQUESTS, "serving", "requests"
+    )
+    if events:
+        base = min(e.t0 for e in events)
+        _emit_events(out, events, base, _PID_SERVING, _PID_REQUESTS)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def merge_fleet(
+    events_by_host: dict[str, tuple[list[FlightEvent], float]],
+) -> list[FlightEvent]:
+    """Merge per-host flight rings onto ONE timebase (PR 20).
+
+    ``events_by_host`` maps a host label to ``(events, offset_s)``
+    where ``offset_s`` translates that host's ``perf_counter`` stamps
+    into the caller's (the front tier's) clock:
+    ``t_front ≈ t_host + offset_s`` — the midpoint estimate from the
+    RTT-halving probe piggybacked on peer ``/debug/chains`` and store
+    stats replies. Returns new events (inputs untouched) with
+    corrected ``t0`` and a ``host`` meta key, sorted by corrected
+    ``t0`` so a joined trace reads monotonically across processes.
+    """
+    merged: list[FlightEvent] = []
+    for host, (events, offset) in events_by_host.items():
+        for e in events:
+            merged.append(
+                FlightEvent(
+                    seq=e.seq,
+                    kind=e.kind,
+                    t0=e.t0 + offset,
+                    dur=e.dur,
+                    trace_id=e.trace_id,
+                    meta={**e.meta, "host": host},
+                )
+            )
+    merged.sort(key=lambda e: (e.t0, e.meta.get("host", ""), e.seq))
+    return merged
+
+
+def to_chrome_fleet(
+    events_by_host: dict[str, tuple[list[FlightEvent], float]],
+) -> dict:
+    """Fleet Chrome export: one ``pid`` pair per host (PR 20).
+
+    Same per-event schema as :func:`to_chrome`, but each host's
+    events land under its own serving/requests process pair (named
+    ``"<host> serving"`` / ``"<host> requests"``) against ONE global
+    time base computed over the clock-corrected stamps — so a single
+    request forwarded front→prefill→store→decode renders as one
+    aligned lane across every process that touched it.
+    """
+    out: list[dict] = []
+    hosts = list(events_by_host)
+    corrected = {
+        host: [
+            FlightEvent(
+                seq=e.seq,
+                kind=e.kind,
+                t0=e.t0 + offset,
+                dur=e.dur,
+                trace_id=e.trace_id,
+                meta=e.meta,
+            )
+            for e in events
+        ]
+        for host, (events, offset) in events_by_host.items()
+    }
+    for i, host in enumerate(hosts):
+        _emit_process_meta(
+            out,
+            10 * i + 1,
+            10 * i + 2,
+            f"{host} serving",
+            f"{host} requests",
         )
-    if not events:
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
-    base = min(e.t0 for e in events)
-
-    def us(t: float) -> float:
-        return round((t - base) * 1e6, 3)
-
-    req_tids: dict[str, int] = {}
-    for e in events:
-        args = dict(e.meta)
-        if e.trace_id is not None:
-            args["trace_id"] = e.trace_id
-        if e.kind == "program":
-            out.append(
-                {
-                    "name": args.get("kind", "program"),
-                    "cat": "device",
-                    "ph": "X",
-                    "ts": us(e.t0),
-                    "dur": round(e.dur * 1e6, 3),
-                    "pid": _PID_SERVING,
-                    "tid": _TID_DEVICE,
-                    "args": args,
-                }
-            )
-        elif e.kind == "host":
-            out.append(
-                {
-                    "name": "sched_host",
-                    "cat": "host",
-                    "ph": "X",
-                    "ts": us(e.t0),
-                    "dur": round(e.dur * 1e6, 3),
-                    "pid": _PID_SERVING,
-                    "tid": _TID_HOST,
-                    "args": args,
-                }
-            )
-        elif e.kind == "request":
-            rid = str(args.get("id", e.trace_id or e.seq))
-            tid = req_tids.setdefault(rid, len(req_tids) + 1)
-            out.append(
-                {
-                    "ph": "M",
-                    "ts": 0,
-                    "pid": _PID_REQUESTS,
-                    "tid": tid,
-                    "name": "thread_name",
-                    "args": {"name": rid},
-                }
-            )
-            out.append(
-                {
-                    "name": rid,
-                    "cat": "request",
-                    "ph": "X",
-                    "ts": us(e.t0),
-                    "dur": round(e.dur * 1e6, 3),
-                    "pid": _PID_REQUESTS,
-                    "tid": tid,
-                    "args": args,
-                }
-            )
-        elif e.dur > 0:
-            out.append(
-                {
-                    "name": e.kind,
-                    "cat": "scheduler",
-                    "ph": "X",
-                    "ts": us(e.t0),
-                    "dur": round(e.dur * 1e6, 3),
-                    "pid": _PID_SERVING,
-                    "tid": _TID_SCHED,
-                    "args": args,
-                }
-            )
-        else:
-            out.append(
-                {
-                    "name": e.kind,
-                    "cat": "scheduler",
-                    "ph": "i",
-                    "s": "t",
-                    "ts": us(e.t0),
-                    "pid": _PID_SERVING,
-                    "tid": _TID_SCHED,
-                    "args": args,
-                }
+    all_events = [e for evs in corrected.values() for e in evs]
+    if all_events:
+        base = min(e.t0 for e in all_events)
+        for i, host in enumerate(hosts):
+            _emit_events(
+                out, corrected[host], base, 10 * i + 1, 10 * i + 2
             )
     return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -99,8 +99,15 @@ from llm_consensus_tpu.server.metrics import (
     TRANSFER_BYTES as _M_XFER,
 )
 from llm_consensus_tpu.serving.offload import HostPageStore
+from llm_consensus_tpu.utils import tracing as _tracing
 
 log = logging.getLogger(__name__)
+
+#: v2 ops that move plane bytes (PR 20): the only ones worth a
+#: ``store_op`` span/flight event — control ops (touch/contains/stats)
+#: would flood the bounded ring from the worker loop for no
+#: attribution value.
+_DATA_OPS = frozenset({"put_counted", "put_many", "get", "get_run"})
 
 __all__ = ["PageStoreServer", "RemotePageStore", "parse_endpoint"]
 
@@ -413,12 +420,18 @@ class PageStoreServer:
                     try:
                         reply = self._handle_v1(payload)
                     except Exception as e:  # noqa: BLE001 - malformed op
-                        reply = ("err", repr(e), self.store.stats_snapshot())
+                        reply = ("err", repr(e), self._stats_stamped())
                     try:
                         _send_frame(conn, pickle.dumps(reply, protocol=4))
                     except OSError:
                         return
                 else:
+                    # Optional third header element (PR 20): the owning
+                    # request's trace id. Length-tolerant both ways —
+                    # an old client sends 2 elements, an old server
+                    # ignores the third.
+                    tid = payload[2] if len(payload) > 2 else None
+                    t_op = time.perf_counter()
                     try:
                         result, out_groups = self._handle_v2(
                             payload[0], payload[1], groups
@@ -426,9 +439,12 @@ class PageStoreServer:
                         status = "ok"
                     except Exception as e:  # noqa: BLE001 - malformed op
                         status, result, out_groups = "err", repr(e), []
+                    self._flight_op(
+                        payload[0], tid, groups, out_groups, t_op
+                    )
                     views, _ = _pack_frame(
                         seq,
-                        (status, result, self.store.stats_snapshot()),
+                        (status, result, self._stats_stamped()),
                         out_groups,
                     )
                     try:
@@ -442,6 +458,44 @@ class PageStoreServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _stats_stamped(self) -> dict:
+        """Store stats + clock-probe stamp (PR 20): the client halves
+        the op's RTT around ``now_pc`` to place this store process's
+        perf_counter timebase on its own — the store-connection leg of
+        the fleet clock-offset estimator."""
+        return {
+            **self.store.stats_snapshot(),
+            "now_pc": time.perf_counter(),
+        }
+
+    def _flight_op(
+        self, op, tid, groups: list, out_groups: list, t_op: float
+    ) -> None:
+        """Record one data-plane op in THIS process's flight ring
+        (PR 20), tagged with the owning trace id — the store-side lane
+        of the merged fleet timeline. Control ops are skipped (the
+        worker loop's touch/contains churn would flood the ring)."""
+        if op not in _DATA_OPS:
+            return
+        try:
+            from llm_consensus_tpu.serving import flight as _flight
+
+            _flight.flight_recorder().record(
+                "store_op",
+                t_op,
+                time.perf_counter() - t_op,
+                trace_id=tid if isinstance(tid, str) else None,
+                op=op,
+                rx_bytes=sum(
+                    int(p.nbytes) for g in groups for p in g
+                ),
+                tx_bytes=sum(
+                    int(p.nbytes) for g in out_groups for p in g
+                ),
+            )
+        except Exception:  # noqa: BLE001 - telemetry must not fail ops
+            pass
 
     def _handle_v1(self, req: tuple) -> tuple:
         """PR-16 ops with pickled plane triples — kept verbatim so a
@@ -463,7 +517,7 @@ class PageStoreServer:
             result = None
         else:
             raise ValueError(f"unknown op {op!r}")
-        return "ok", result, store.stats_snapshot()
+        return "ok", result, self._stats_stamped()
 
     def _handle_v2(self, op: str, args: tuple, groups: list) -> tuple:
         """v2 ops: planes arrive/depart as raw frame groups, never
@@ -591,6 +645,14 @@ class RemotePageStore:
         #: stats mirrors of ``gateway_transfer_bytes_total{dir=...}``.
         self.tx_bytes = 0
         self.rx_bytes = 0
+        #: Clock-offset estimate for the store host (PR 20):
+        #: ``t_local ≈ t_store + clock_offset``, from halving each v2
+        #: op's RTT around the ``now_pc`` stamp the server piggybacks
+        #: on every reply; the min-RTT observation wins (the tightest
+        #: round trip bounds the midpoint error). None until a reply
+        #: carrying the stamp lands.
+        self.clock_offset: float | None = None
+        self.clock_rtt: float | None = None
         # Last piggybacked authoritative-store snapshot: the cache
         # behind every read property (no network on the read path).
         self._stats: dict = {}
@@ -657,6 +719,31 @@ class RemotePageStore:
                 endpoint=self.endpoint,
                 state=state,
                 **extra,
+            )
+        except Exception:  # noqa: BLE001 - telemetry must not fail ops
+            pass
+
+    def _flight_op(
+        self, op: str, tid, tx: int, rx: int, dur: float
+    ) -> None:
+        """One data-plane op in this process's flight ring (PR 20),
+        tagged with the owning trace id and the bytes it moved — the
+        per-request attribution of the
+        ``gateway_transfer_bytes_total`` increments the same op made
+        (the counter itself stays label-bounded; the flight event
+        carries the join key)."""
+        try:
+            from llm_consensus_tpu.serving import flight as _flight
+
+            _flight.flight_recorder().record(
+                "store_op",
+                time.perf_counter() - dur,
+                dur,
+                trace_id=tid,
+                op=op,
+                endpoint=self.endpoint,
+                tx_bytes=tx,
+                rx_bytes=rx,
             )
         except Exception:  # noqa: BLE001 - telemetry must not fail ops
             pass
@@ -781,7 +868,16 @@ class RemotePageStore:
         """One pipelined op. Returns ``(True, result, plane_groups)``
         or None after ANY failure. The send is the only serialized
         section; the reply is awaited without holding any lock, so
-        concurrent callers keep the wire full. Never raises."""
+        concurrent callers keep the wire full. Never raises.
+
+        Trace join (PR 20): the owning request's trace id (the
+        contextvar the handoff worker propagated) rides the v2 header
+        as an optional third element — the server tags its own flight
+        ring with it, and this side lands a ``store_op`` span on the
+        trace plus a flight event carrying the moved bytes, so wire
+        transfers attribute to the request that caused them."""
+        trace = _tracing.current_trace()
+        tid = trace.trace_id if trace is not None else None
         with self._lock:
             if time.monotonic() < self._down_until:
                 self.errors += 1
@@ -799,7 +895,7 @@ class RemotePageStore:
                 with self._lock:
                     self._seq = seq = (self._seq + 1) & 0xFFFFFFFF
                     self._pending[seq] = pend
-                views, tx = _pack_frame(seq, (op, args), groups)
+                views, tx = _pack_frame(seq, (op, args, tid), groups)
                 _send_vec(sock, views)
             self._count_xfer("tx", tx)
         except (
@@ -829,8 +925,30 @@ class RemotePageStore:
         status, result, stats = pend.reply
         with self._lock:
             self._stats = stats
-        _M_RTT.observe(time.perf_counter() - pend.t0)
+        t1 = time.perf_counter()
+        dur = t1 - pend.t0
+        _M_RTT.observe(dur)
         _M_BYTES.set(stats.get("bytes_used", 0))
+        # Clock-offset piggyback (PR 20): every reply carrying the
+        # server's ``now_pc`` stamp refines the estimate; min-RTT wins.
+        now = stats.get("now_pc")
+        if isinstance(now, (int, float)) and (
+            self.clock_rtt is None or dur <= self.clock_rtt
+        ):
+            self.clock_offset = (pend.t0 + t1) / 2.0 - float(now)
+            self.clock_rtt = dur
+        if op in _DATA_OPS:
+            rx = sum(int(p.nbytes) for g in pend.groups for p in g)
+            if trace is not None:
+                trace.add_span(
+                    "store_op",
+                    pend.t0,
+                    dur,
+                    op=op,
+                    tx_bytes=tx,
+                    rx_bytes=rx,
+                )
+            self._flight_op(op, tid, tx, rx, dur)
         if status != "ok":
             self.errors += 1
             _M_ERRORS.inc()

@@ -358,12 +358,30 @@ class TraceStore:
                 self._evicted += 1
                 _notify_drop("trace", 1)
 
-    def start(self, name: str, **meta) -> Trace | None:
-        """Open (and retain) a new trace; ``None`` when tracing is off."""
+    def start(
+        self, name: str, trace_id: str | None = None, **meta
+    ) -> Trace | None:
+        """Open (and retain) a new trace; ``None`` when tracing is off.
+
+        ``trace_id`` ADOPTS a propagated id instead of minting one
+        (PR 20): a gateway receiving a forwarded request under
+        ``X-Trace-Id`` opens its local trace under the FRONT's id, so
+        the hop's spans join the originating request's trace when the
+        fleet view merges them. Adoption is per process — each process
+        keeps its own Trace object (its own clock origin and span
+        ring); the shared id is the join key, never shared state. An
+        invalid propagated id (non-hex, wrong length) is ignored and a
+        fresh id minted — a malicious or corrupt header must not poison
+        the store's keying."""
         if not _ENABLED:
             return None
+        if trace_id is not None and not _adoptable_id(trace_id):
+            trace_id = None
         trace = Trace(
-            uuid.uuid4().hex[:16], name, max_spans=self.max_spans, meta=meta
+            trace_id or uuid.uuid4().hex[:16],
+            name,
+            max_spans=self.max_spans,
+            meta={**meta, **({"adopted": True} if trace_id else {})},
         )
         with self._lock:
             while len(self._traces) >= self.max_traces:
@@ -401,6 +419,16 @@ class TraceStore:
     def clear(self) -> None:
         with self._lock:
             self._traces.clear()
+
+
+def _adoptable_id(trace_id: str) -> bool:
+    """A propagated trace id this store will adopt verbatim: 8-64
+    hex-ish chars (the local mint is 16 lowercase hex). Bounded and
+    charset-checked so a hostile ``X-Trace-Id`` header cannot stuff
+    megabyte keys or control bytes into the store."""
+    if not isinstance(trace_id, str) or not (8 <= len(trace_id) <= 64):
+        return False
+    return all(c in "0123456789abcdefABCDEF-" for c in trace_id)
 
 
 _STORE = TraceStore()

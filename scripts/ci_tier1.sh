@@ -27,7 +27,7 @@ if [ "$1" = "--smoke" ]; then
     tests/test_mesh_serving.py tests/test_replica_fleet.py \
     tests/test_adaptive_control.py tests/test_disagg.py \
     tests/test_kv_transfer.py tests/test_multi_model.py \
-    tests/test_fleet_control.py \
+    tests/test_fleet_control.py tests/test_fleet_observability.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 set -o pipefail

@@ -508,6 +508,8 @@ def test_debug_chains_endpoint():
     handle, client, _ = _boot(_probed_backend(registry_tokens=48))
     try:
         doc = client._json("GET", "/debug/chains?ids=1,2,3")
+        # PR 20: the probe reply carries a clock-probe stamp too.
+        assert doc.pop("now_pc") > 0
         assert doc == {"n_ids": 3, "registry_tokens": 48, "host_tokens": 0}
         n = len(ByteTokenizer().encode("hi"))
         doc = client._json("GET", "/debug/chains?prompt=hi")

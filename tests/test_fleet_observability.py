@@ -1,0 +1,468 @@
+"""Fleet-wide observability federation (PR 20).
+
+Covers the three coupled mechanisms end to end: cross-hop trace joins
+(an ``X-Trace-Id`` rides the forwarded REQUEST and the peer ADOPTS it,
+so the front's routing spans and the peer's serving spans share one
+id; the remote-store wire carries the same id so store ops land as
+spans/flight events on the owning trace), fleet aggregation
+(``/metrics?fleet=1`` merges per-host expositions under ``host=``
+labels with sums in lockstep; ``/debug/flight?fleet=1`` merges flight
+rings onto one timebase via the RTT-halving clock-offset estimator,
+one Chrome ``pid`` pair per host), and per-hop attribution (response
+``meta["hops"]`` partitions the request's latency into front_route /
+admission_wait / prefill / decode / handoff / wire_transfer, mirrored
+into ``gateway_hop_seconds{hop=}``, with ``gateway_slo_burn_rate``
+readable by the PR-19 FleetController as spawn pressure).
+
+CPU-only: real sockets between in-process gateways (which share the
+process-global trace store and flight ring — assertions here are
+about joins and presence, never exact per-host event counts).
+"""
+
+import asyncio
+import json
+import re
+import time
+
+import numpy as np
+import pytest
+
+from llm_consensus_tpu.backends.fake import FakeBackend
+from llm_consensus_tpu.server.admission import (
+    AdmissionConfig,
+    AdmissionController,
+)
+from llm_consensus_tpu.server.client import GatewayClient
+from llm_consensus_tpu.server.gateway import (
+    Gateway,
+    GatewayConfig,
+    GatewayThread,
+    _merge_metrics_text,
+)
+from llm_consensus_tpu.server.metrics import MetricsRegistry
+from llm_consensus_tpu.utils import tracing
+
+_TID = "feedfacefeedface"
+
+
+def _boot(backend, admission=None, **gw_kw):
+    """Gateway on an ephemeral port with an isolated registry."""
+    reg = MetricsRegistry()
+    gw = Gateway(
+        backend,
+        config=GatewayConfig(
+            port=0, admission=admission or AdmissionConfig(), **gw_kw
+        ),
+        registry=reg,
+    )
+    handle = GatewayThread(gw).start()
+    return handle, GatewayClient("127.0.0.1", handle.port), reg
+
+
+def _flatten(nodes):
+    for n in nodes:
+        yield n
+        yield from _flatten(n["children"])
+
+
+# ---------------------------------------------------------------------------
+# Trace adoption: X-Trace-Id on the request roots the local spans
+# ---------------------------------------------------------------------------
+
+
+def test_incoming_trace_id_is_adopted():
+    handle, client, _ = _boot(FakeBackend())
+    try:
+        r = client.generate("adopt me", headers={"X-Trace-Id": _TID})
+        assert r["trace_id"] == _TID
+        tree = client.traces(_TID)
+        assert tree["meta"]["adopted"] is True
+        names = {n["name"] for n in _flatten(tree["spans"])}
+        assert "queued" in names and "execute" in names
+        # A hostile/corrupt id is NOT adopted — a fresh id is minted.
+        r = client.generate("bad id", headers={"X-Trace-Id": "zz!"})
+        assert r["trace_id"] != "zz!" and r["trace_id"]
+    finally:
+        handle.drain()
+    # fleet_obs=False: the header is ignored entirely.
+    handle, client, _ = _boot(FakeBackend(), fleet_obs=False)
+    try:
+        r = client.generate("no obs", headers={"X-Trace-Id": _TID})
+        assert r["trace_id"] != _TID
+        assert "hops" not in (r.get("meta") or {})
+    finally:
+        handle.drain()
+
+
+def test_trace_joins_across_real_peer_forward():
+    """ISSUE 20 acceptance (join half): a request forwarded through a
+    front gateway carries ONE trace id end to end — the front mints
+    it, the peer adopts it, the relayed response and header agree, and
+    the relayed ``meta["hops"]`` gains the front's own routing hop."""
+    peer_h, _, peer_reg = _boot(FakeBackend())
+    peer_url = f"http://127.0.0.1:{peer_h.port}"
+    front_h, front_client, front_reg = _boot(
+        FakeBackend(), peers=(peer_url,)
+    )
+    try:
+        resp, data = front_client._request(
+            "POST", "/v1/generate", {"prompt": "join me"}
+        )
+        assert resp.getheader("X-Peer") == peer_url
+        tid = resp.getheader("X-Trace-Id")
+        assert tid
+        doc = json.loads(data)
+        # The PEER served it, under the FRONT's id (adoption).
+        assert doc["trace_id"] == tid
+        hops = doc["meta"]["hops"]
+        assert hops["front_route"] >= 0.0
+        assert hops["decode"] >= 0.0 and hops["admission_wait"] >= 0.0
+        # The adopted trace is retrievable under the shared id with the
+        # peer's serving spans in it.
+        tree = front_client.traces(tid)
+        assert tree["meta"]["adopted"] is True
+        assert "queued" in {n["name"] for n in _flatten(tree["spans"])}
+        # Both tiers observed their hop histograms.
+        assert 'gateway_hop_seconds_bucket{hop="front_route"' in (
+            front_reg.render()
+        )
+        assert 'gateway_hop_seconds_bucket{hop="decode"' in (
+            peer_reg.render()
+        )
+    finally:
+        front_h.drain()
+        peer_h.drain()
+
+
+# ---------------------------------------------------------------------------
+# Clock-offset estimator + fleet timeline merge
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offset_midpoint_estimator():
+    off, rtt = Gateway._clock_offset({"now_pc": 50.0}, 100.0, 100.2)
+    assert off == pytest.approx(50.1)
+    assert rtt == pytest.approx(0.2)
+    # Peers predating the stamp yield no estimate.
+    assert Gateway._clock_offset({}, 0.0, 1.0) == (None, None)
+    assert Gateway._clock_offset({"now_pc": "x"}, 0.0, 1.0) == (None, None)
+    # Min-RTT observation wins (NTP-style): a tighter exchange
+    # replaces a looser one; a looser one never degrades the estimate.
+    gw = Gateway(FakeBackend(), config=GatewayConfig(port=0))
+    gw._note_offset("h", 1.0, 0.5)
+    gw._note_offset("h", 9.0, 0.8)
+    assert gw._peer_offsets["h"] == (1.0, 0.5)
+    gw._note_offset("h", 3.0, 0.1)
+    assert gw._peer_offsets["h"] == (3.0, 0.1)
+    gw._note_offset("h", None, None)  # no stamp -> no-op
+    assert gw._peer_offsets["h"] == (3.0, 0.1)
+
+
+def test_merge_fleet_corrects_skew_monotonic():
+    """Synthetic skewed rings: host B's perf_counter origin is ~990 s
+    ahead; after its probe-derived offset is applied the merged
+    timeline interleaves the hosts in true wall order, monotonically."""
+    from llm_consensus_tpu.serving.flight import FlightEvent, merge_fleet
+
+    a = [
+        FlightEvent(seq=i, kind="program", t0=10.0 + 0.2 * i, dur=0.01,
+                    trace_id=_TID, meta={})
+        for i in range(3)
+    ]
+    b = [
+        FlightEvent(seq=i, kind="store_op", t0=1000.05 + 0.2 * i,
+                    dur=0.01, trace_id=_TID, meta={})
+        for i in range(3)
+    ]
+    merged = merge_fleet({"A": (a, 0.0), "B": (b, -990.0)})
+    t0s = [e.t0 for e in merged]
+    assert t0s == sorted(t0s)
+    assert [e.meta["host"] for e in merged] == [
+        "A", "B", "A", "B", "A", "B"
+    ]
+    assert all(e.trace_id == _TID for e in merged)
+    # Inputs untouched (new events, corrected copies).
+    assert "host" not in a[0].meta and b[0].t0 == pytest.approx(1000.05)
+
+
+def test_to_chrome_fleet_one_pid_pair_per_host():
+    from llm_consensus_tpu.serving.flight import (
+        FlightEvent,
+        to_chrome_fleet,
+    )
+
+    a = [FlightEvent(seq=0, kind="program", t0=5.0, dur=0.01,
+                     trace_id=None, meta={"rows": 2})]
+    b = [FlightEvent(seq=0, kind="handoff", t0=1000.0, dur=0.02,
+                     trace_id=_TID, meta={})]
+    doc = to_chrome_fleet({"A": (a, 0.0), "B": (b, -994.0)})
+    names = {
+        ev["args"]["name"]: ev["pid"]
+        for ev in doc["traceEvents"]
+        if ev.get("name") == "process_name"
+    }
+    assert "A serving" in names and "B serving" in names
+    assert len({names["A serving"], names["B serving"]}) == 2
+    slices = [
+        ev for ev in doc["traceEvents"] if ev.get("ph") == "X"
+    ]
+    # One global base over the CORRECTED stamps: every ts >= 0 and the
+    # hosts' slices land ~1 s apart, not ~995 s.
+    assert slices and all(ev["ts"] >= 0 for ev in slices)
+    assert max(ev["ts"] for ev in slices) < 5e6
+
+
+# ---------------------------------------------------------------------------
+# /metrics federation: host= labels, sums in lockstep
+# ---------------------------------------------------------------------------
+
+
+def test_merge_metrics_text_sums_lockstep():
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.counter("demo_total", "demo").labels(route="/x").inc(3)
+    rb.counter("demo_total", "demo").labels(route="/x").inc(4)
+    ha = ra.histogram("lat_seconds", "lat", buckets=(1.0,))
+    ha.observe(0.5)
+    merged = _merge_metrics_text(
+        {"self": ra.render(), "http://p:1": rb.render()}
+    )
+    assert 'demo_total{host="self",route="/x"} 3' in merged
+    assert 'demo_total{host="http://p:1",route="/x"} 4' in merged
+    # HELP/TYPE dedupe to ONE copy per family.
+    assert merged.count("# TYPE demo_total counter") == 1
+    # Histogram series group under their base family with the host
+    # label injected first.
+    assert 'lat_seconds_bucket{host="self",le="1"} 1' in merged
+    assert 'lat_seconds_count{host="self"} 1' in merged
+    # Values relay verbatim: the merged sum IS the per-host sum.
+    vals = [
+        float(m.group(1))
+        for m in re.finditer(r"^demo_total\{[^}]*\} (\S+)$",
+                             merged, re.M)
+    ]
+    assert sum(vals) == 7.0
+
+
+def test_metrics_fleet_federation_live():
+    peer_h, peer_client, _ = _boot(FakeBackend())
+    peer_url = f"http://127.0.0.1:{peer_h.port}"
+    front_h, front_client, _ = _boot(FakeBackend(), peers=(peer_url,))
+    try:
+        front_client.generate("federate me")
+        _, data = front_client._request("GET", "/metrics?fleet=1")
+        merged = data.decode()
+        assert 'host="self"' in merged
+        assert f'host="{peer_url}"' in merged
+        # The forwarded generate was counted once per tier; the merged
+        # view's sum over the family equals the per-tier scrapes' sum.
+        pat = re.compile(
+            r'^gateway_requests_total\{[^}]*route="/v1/generate"[^}]*\}'
+            r" (\S+)$",
+            re.M,
+        )
+        merged_sum = sum(float(v) for v in pat.findall(merged))
+        plain_sum = sum(
+            float(v)
+            for text in (front_client.metrics(), peer_client.metrics())
+            for v in pat.findall(text)
+        )
+        assert merged_sum == plain_sum == 2.0
+    finally:
+        front_h.drain()
+        peer_h.drain()
+    # fleet_obs=False: ?fleet=1 degrades to the plain exposition.
+    handle, client, _ = _boot(FakeBackend(), fleet_obs=False)
+    try:
+        _, data = client._request("GET", "/metrics?fleet=1")
+        assert 'host="' not in data.decode()
+    finally:
+        handle.drain()
+
+
+# ---------------------------------------------------------------------------
+# /debug/flight?fleet=1: merged cross-process timeline (live)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_fleet_merged_timeline_live():
+    from llm_consensus_tpu.serving import flight as _flight
+
+    was = _flight.enabled()
+    _flight.set_enabled(True)
+    peer_h, _, _ = _boot(FakeBackend())
+    peer_url = f"http://127.0.0.1:{peer_h.port}"
+    front_h, front_client, _ = _boot(FakeBackend(), peers=(peer_url,))
+    try:
+        _flight.flight_recorder().record(
+            "store_op",
+            time.perf_counter(),
+            0.001,
+            trace_id=_TID,
+            op="put_many",
+        )
+        doc = front_client._json("GET", "/debug/flight?fleet=1")
+        assert doc["unreachable"] == []
+        assert doc["hosts"]["self"]["offset_s"] == 0.0
+        # The scrape itself doubled as a clock probe: the peer has a
+        # finite offset/rtt estimate (near zero — same process clock).
+        assert abs(doc["hosts"][peer_url]["offset_s"]) < 5.0
+        assert doc["hosts"][peer_url]["rtt_s"] > 0.0
+        hosts_seen = {e["host"] for e in doc["events"]}
+        assert {"self", peer_url} <= hosts_seen
+        # The tagged store op joins the merged view by its trace id.
+        assert any(
+            e.get("trace_id") == _TID for e in doc["events"]
+        )
+        chrome = front_client._json(
+            "GET", "/debug/flight?fleet=1&format=chrome"
+        )
+        pnames = {
+            ev["args"]["name"]
+            for ev in chrome["traceEvents"]
+            if ev.get("name") == "process_name"
+        }
+        assert "self serving" in pnames
+        assert f"{peer_url} serving" in pnames
+    finally:
+        _flight.set_enabled(was)
+        front_h.drain()
+        peer_h.drain()
+
+
+# ---------------------------------------------------------------------------
+# Remote-store wire: ops land as spans/flight events on the owning trace
+# ---------------------------------------------------------------------------
+
+
+def test_store_ops_ride_the_owning_trace():
+    from llm_consensus_tpu.serving import flight as _flight
+    from llm_consensus_tpu.serving.offload import HostPageStore
+    from llm_consensus_tpu.serving.remote_store import (
+        PageStoreServer,
+        RemotePageStore,
+    )
+
+    was = _flight.enabled()
+    _flight.set_enabled(True)
+    store = HostPageStore(budget_bytes=64 << 20)
+    server = PageStoreServer(store).start()
+    client = RemotePageStore(server.endpoint, timeout_s=10.0)
+    trace = tracing.trace_store().start("store-owner")
+    planes = (
+        np.arange(64, dtype=np.float32),
+        np.ones(32, dtype=np.float32),
+    )
+    try:
+        with tracing.use_trace(trace):
+            client.put_counted(("chain", 0), planes)
+            got = client.get(("chain", 0))
+        assert got is not None
+        ops = {
+            s.meta["op"]: s
+            for s in trace.spans()
+            if s.name == "store_op"
+        }
+        assert {"put_counted", "get"} <= set(ops)
+        assert ops["put_counted"].meta["tx_bytes"] > 0
+        assert ops["get"].meta["rx_bytes"] > 0
+        assert all(s.duration > 0 for s in ops.values())
+        # The id crossed the WIRE: both the client-side and the
+        # server-side flight events carry the owning trace's id.
+        tagged = [
+            e
+            for e in _flight.flight_recorder().events()
+            if e.kind == "store_op" and e.trace_id == trace.trace_id
+        ]
+        assert len(tagged) >= 2
+        assert {e.meta.get("op") for e in tagged} >= {
+            "put_counted",
+            "get",
+        }
+        # Control ops outside a trace context do not tag events...
+        n_before = len(_flight.flight_recorder().events())
+        assert ("chain", 0) in client
+        assert len(client) >= 1  # stats piggyback (control plane)
+        n_after = len(
+            [
+                e
+                for e in _flight.flight_recorder().events()
+                if e.kind == "store_op"
+            ]
+        )
+        assert n_after <= n_before  # no store_op churn from control ops
+        # ...and every stamped reply refined the clock estimate.
+        assert client.clock_offset is not None
+        assert client.clock_rtt > 0.0
+    finally:
+        _flight.set_enabled(was)
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-hop attribution: hops ~= e2e; burn-rate readable by the controller
+# ---------------------------------------------------------------------------
+
+
+def test_hop_breakdown_tracks_client_e2e():
+    """ISSUE 20 acceptance (attribution half): the response's hop sum
+    lands within tolerance of the client-observed latency when the
+    backend dominates (FakeBackend sleeping 150 ms)."""
+    handle, client, reg = _boot(FakeBackend(latency=0.15))
+    try:
+        t0 = time.monotonic()
+        r = client.generate("how long")
+        e2e = time.monotonic() - t0
+        hops = r["meta"]["hops"]
+        assert set(hops) <= {
+            "front_route",
+            "admission_wait",
+            "prefill",
+            "decode",
+            "handoff",
+            "wire_transfer",
+        }
+        assert hops["decode"] >= 0.14
+        total = sum(hops.values())
+        assert abs(total - e2e) <= 0.10 * e2e + 0.05
+        text = reg.render()
+        assert 'gateway_hop_seconds_bucket{hop="decode"' in text
+        assert 'gateway_hop_seconds_bucket{hop="admission_wait"' in text
+    finally:
+        handle.drain()
+
+
+def test_burn_rate_gauge_and_fleet_controller_lockstep():
+    from llm_consensus_tpu.serving.fleet_control import (
+        FleetControlConfig,
+        FleetController,
+    )
+
+    async def main():
+        reg = MetricsRegistry()
+        c = AdmissionController(
+            AdmissionConfig(slo_classes={"fast": 0.05}), registry=reg
+        )
+        c._burn_observe("fast", missed=True)
+        c._burn_observe("fast", missed=True)
+        c._burn_observe("fast", missed=False)
+        rates = c.burn_rates()
+        assert 0.0 < rates["fast"] < 1.0
+        assert c.stats()["slo_burn_rate"] == rates
+        m = re.search(
+            r'gateway_slo_burn_rate\{class="fast"\} (\S+)', reg.render()
+        )
+        assert m and float(m.group(1)) == pytest.approx(
+            rates["fast"], rel=1e-9
+        )
+        # The PR-19 controller reads the same numbers in-process once
+        # the CLI attaches the gateway's admission tier.
+        fc = FleetController(
+            type("_Fleet", (), {})(), FleetControlConfig()
+        )
+        assert fc.burn_rates() == {}
+        fc.attach_admission(c)
+        assert fc.burn_rates() == rates
+        assert fc.stats()["fleet_burn_rate"] == rates
+
+    asyncio.run(main())
